@@ -1,0 +1,27 @@
+"""dss_ml_at_scale_tpu — a TPU-native scale-out ML framework.
+
+A ground-up JAX/XLA/pjit re-design of the capability surface of the
+``sebrahimi1988/dss-ml-at-scale`` Databricks tutorial stack (Spark +
+Petastorm + PyTorch Lightning DDP + Hyperopt SparkTrials + applyInPandas),
+re-architected for TPU hardware:
+
+- ``runtime``   — device-mesh topology, multi-host init, CPU-simulated slices
+- ``data``      — sharded Arrow/Parquet streaming loader + Delta-log reader
+                  (replaces Petastorm ``make_batch_reader`` + deltalake-rs)
+- ``models``    — Flax model zoo (ResNet-50 flagship) + psum-reduced metrics
+- ``ops``       — JAX numerical kernels: Kalman/SARIMAX, Holt-Winters, ARMA,
+                  vmappable Nelder-Mead (replaces statsmodels in the
+                  group-apply track)
+- ``parallel``  — data-parallel Trainer, distributed HPO trials executor,
+                  group-apply engine (replaces TorchDistributor/DDP,
+                  SparkTrials, groupBy().applyInPandas())
+- ``hpo``       — TPE + search spaces + fmin (hyperopt-compatible surface)
+- ``tracking``  — run/param/metric store (replaces the MLflow wiring)
+- ``config``    — dataclass configs + CLI (replaces dbutils.widgets / RUNME)
+- ``datagen``   — synthetic demand / BoM / sized-regression generators
+- ``ingest``    — image-dataset → Parquet ingestion tooling
+
+Reference capability map: see SURVEY.md §2 at the repo root.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Config + CLI (replaces dbutils.widgets / RUNME job JSON)."""

@@ -1,0 +1,48 @@
+"""`dsst` command-line entry point.
+
+Replaces the reference's three config surfaces — ``dbutils.widgets``,
+module-level constant cells, and the RUNME job JSON (SURVEY.md §5.6) —
+with ordinary subcommands. Subcommands register here as workloads land.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dsst",
+        description="dss_ml_at_scale_tpu: TPU-native scale-out ML framework",
+    )
+    sub = parser.add_subparsers(dest="command")
+    info = sub.add_parser("info", help="show runtime topology and devices")
+    info.set_defaults(fn=_cmd_info)
+    return parser
+
+
+def _cmd_info(_args: argparse.Namespace) -> int:
+    import jax
+
+    from ..runtime import local_topology
+
+    topo = local_topology()
+    print(f"process {topo.process_index}/{topo.process_count}")
+    print(f"devices {topo.local_device_count} local / {topo.global_device_count} global")
+    for d in jax.local_devices():
+        print(f"  {d}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "fn", None):
+        parser.print_help()
+        return 2
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

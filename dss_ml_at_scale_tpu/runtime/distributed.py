@@ -1,0 +1,77 @@
+"""Multi-host runtime initialization (the TorchDistributor replacement).
+
+The reference launches one torch process per Spark task and wires NCCL
+rendezvous env (``MASTER_ADDR``/``NODE_RANK``) through
+``TorchDistributor(...).run(...)`` (reference
+``deep_learning/2.distributed-data-loading-petastorm.py:444-470``).
+
+The TPU-native shape is much smaller: one Python process per TPU host,
+``jax.distributed.initialize`` for rendezvous over DCN, and ICI collectives
+inside compiled programs. There is no launcher process tree to manage —
+the platform (GKE/Ray/gcloud) starts one process per host and this module
+connects them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Connect this process to the multi-host JAX runtime.
+
+    No-op when running single-process (the common single-host case: all
+    local chips are visible without any rendezvous — the analogue of the
+    reference's ``local_mode=True`` path needing no cluster).
+
+    Arguments fall back to the standard env vars
+    (``COORDINATOR_ADDRESS``, ``NUM_PROCESSES``, ``PROCESS_ID``) so a
+    launcher script can wire topology exactly like TorchDistributor wired
+    ``NODE_RANK`` — but through one call instead of ambient globals.
+    """
+    global _INITIALIZED
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if _INITIALIZED:
+        if coordinator_address is not None:
+            log.warning(
+                "initialize_distributed called again with "
+                "coordinator_address=%s after jax.distributed was already "
+                "initialized; ignoring",
+                coordinator_address,
+            )
+        return
+    if coordinator_address is None:
+        # Single-process path: do NOT latch _INITIALIZED — a later call
+        # that does carry rendezvous info must still be able to connect.
+        log.info("no coordinator address; running single-process")
+        return
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+    # None values pass through: jax.distributed.initialize auto-detects
+    # topology on Cloud TPU when not told explicitly.
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _INITIALIZED = True
+    log.info(
+        "jax.distributed initialized: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )

@@ -1,0 +1,118 @@
+"""Device-mesh construction and sharding helpers.
+
+This is the layer the reference delegates to NCCL process groups
+(``torch.distributed`` DDP set up by ``TorchDistributor``; reference
+``deep_learning/2.distributed-data-loading-petastorm.py:363,390-393,446-470``).
+On TPU the equivalent first-class object is a :class:`jax.sharding.Mesh`
+over which `pjit`-compiled programs place XLA collectives on ICI/DCN.
+
+Design notes (TPU-first):
+
+- One mesh, many strategies. Data parallelism ("data" axis), tensor
+  parallelism ("model" axis), and group parallelism (sharding a groups axis)
+  are all expressed as NamedSharding over the same mesh — there is no
+  separate "DDP strategy" object.
+- The mesh is host-aware: axis sizes default so that the "data" axis spans
+  all devices across all processes, matching the reference's
+  ``WORLD_SIZE = num_tasks * num_proc_per_task`` arithmetic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh description.
+
+    ``axes`` maps axis name -> size; at most one axis may be -1, meaning
+    "all remaining devices". Axis order is layout order (last axis varies
+    fastest over the device list, i.e. is most ICI-local on a real slice).
+    """
+
+    axes: Mapping[str, int] = dataclasses.field(
+        default_factory=lambda: {"data": -1}
+    )
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = dict(self.axes)
+        bad = {k: v for k, v in sizes.items() if v != -1 and v < 1}
+        if bad:
+            raise ValueError(f"mesh axis sizes must be positive or -1, got {bad}")
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} wants {fixed} devices, have {n_devices}"
+            )
+        return sizes
+
+
+def make_mesh(
+    spec: MeshSpec | Mapping[str, int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh. Default: 1-D "data" mesh over every device.
+
+    ``devices`` defaults to ``jax.devices()`` — i.e. all devices across all
+    processes in a multi-host run, which is what data-parallel training
+    wants (the reference computes the same WORLD_SIZE from Spark task
+    count; here the JAX runtime already knows the global device set).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec()
+    elif not isinstance(spec, MeshSpec):
+        spec = MeshSpec(dict(spec))
+    sizes = spec.resolve(len(devices))
+    arr = np.asarray(devices, dtype=object).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data", ndim: int = 4) -> NamedSharding:
+    """Sharding that splits dim 0 (batch) across ``axis``, replicates rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch_to_mesh(batch, mesh: Mesh, axis: str = "data"):
+    """Place a host-global pytree of arrays onto the mesh, batch-sharded.
+
+    In a multi-process run each process passes its *local* shard and JAX
+    assembles the global array (``jax.make_array_from_process_local_data``);
+    single-process, this is a plain sharded device_put. Scalar (0-d)
+    leaves have no batch dim and are replicated.
+    """
+    def _place(x):
+        if np.ndim(x) == 0:
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        if np.shape(x)[0] % mesh.shape[axis]:
+            raise ValueError(
+                f"leading (batch) dim {np.shape(x)[0]} not divisible by mesh "
+                f"axis '{axis}' of size {mesh.shape[axis]}"
+            )
+        sharding = NamedSharding(mesh, P(axis, *([None] * (np.ndim(x) - 1))))
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+        return jax.device_put(x, sharding)
+
+    return jax.tree_util.tree_map(_place, batch)

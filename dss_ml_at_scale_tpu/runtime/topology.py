@@ -1,0 +1,52 @@
+"""Process/device topology helpers.
+
+Replaces the reference's rank arithmetic, where each Spark barrier task
+reads ``NODE_RANK`` from env and computes
+``WORLD_SIZE = NUM_TASKS * NUM_PROC_PER_TASK`` by hand (reference
+``deep_learning/2.distributed-data-loading-petastorm.py:367-368``).
+Under JAX the runtime owns this: ``jax.process_index()`` is the host rank
+and the device set is global; we expose one small struct so the rest of
+the framework never touches env vars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_index == 0
+
+    def global_batch_for(self, per_device_batch: int) -> int:
+        return per_device_batch * self.global_device_count
+
+    def steps_per_epoch(self, total_rows: int, per_device_batch: int) -> int:
+        """Epoch accounting: rows // (batch × world).
+
+        Mirrors the reference's
+        ``train_steps_per_epoch = train_rows // (BATCH_SIZE * WORLD_SIZE)``
+        (``deep_learning/2...py:387-388``) which it feeds to Lightning's
+        ``limit_train_batches`` to draw epoch boundaries on an infinite
+        sharded reader.
+        """
+        denom = per_device_batch * self.global_device_count
+        return max(1, total_rows // denom)
+
+
+def local_topology() -> Topology:
+    return Topology(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )

@@ -1,0 +1,36 @@
+"""Test rig: simulate an 8-device TPU slice on host CPU.
+
+The reference has no fake backend; its closest move is single-node
+multi-process DDP (SURVEY.md §4.5). The TPU-native analogue is XLA's
+host-platform device multiplexing: 8 virtual CPU devices behave like an
+8-chip slice for sharding/collective semantics (not performance).
+
+This must run before any test triggers JAX backend init, hence conftest
+import time: XLA_FLAGS via env, platform via jax.config (the env var
+alone is overridden by preregistered PJRT plugins on some hosts).
+"""
+
+import os
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,75 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dss_ml_at_scale_tpu.runtime import (
+    MeshSpec,
+    Topology,
+    batch_sharding,
+    local_topology,
+    make_mesh,
+    replicated_sharding,
+    shard_batch_to_mesh,
+)
+
+
+def test_default_mesh_spans_all_devices(devices8):
+    mesh = make_mesh()
+    assert mesh.axis_names == ("data",)
+    assert mesh.devices.shape == (8,)
+
+
+def test_mesh_spec_resolve():
+    assert MeshSpec({"data": -1, "model": 2}).resolve(8) == {"data": 4, "model": 2}
+    assert MeshSpec({"data": 8}).resolve(8) == {"data": 8}
+    with pytest.raises(ValueError):
+        MeshSpec({"data": 3}).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec({"a": -1, "b": -1}).resolve(8)
+
+
+def test_2d_mesh_and_collective(devices8):
+    mesh = make_mesh({"data": 4, "model": 2})
+    x = jax.device_put(jnp.arange(8.0).reshape(4, 2), NamedSharding(mesh, P("data", "model")))
+    total = jax.jit(lambda v: v.sum())(x)
+    assert float(total) == 28.0
+
+
+def test_batch_sharding_places_batch_on_data_axis(devices8):
+    mesh = make_mesh()
+    batch = {"x": np.ones((16, 4), np.float32), "y": np.arange(16)}
+    placed = shard_batch_to_mesh(batch, mesh)
+    assert placed["x"].sharding.spec == P("data", None)
+    assert placed["y"].sharding.spec == P("data")
+    np.testing.assert_array_equal(np.asarray(placed["y"]), batch["y"])
+    assert batch_sharding(mesh, ndim=2).spec == P("data", None)
+    assert replicated_sharding(mesh).spec == P()
+
+
+def test_topology_steps_per_epoch():
+    topo = Topology(0, 1, 8, 8)
+    # Mirrors rows // (batch * world): 10_000 // (212 * 8)
+    assert topo.steps_per_epoch(10_000, 212) == 5
+    assert topo.steps_per_epoch(10, 212) == 1  # floor at 1
+    assert topo.global_batch_for(212) == 1696
+
+
+def test_local_topology(devices8):
+    topo = local_topology()
+    assert topo.process_count == 1
+    assert topo.global_device_count == 8
+    assert topo.is_coordinator
+
+
+def test_psum_over_data_axis(devices8):
+    mesh = make_mesh()
+    x = shard_batch_to_mesh(np.ones((8, 2), np.float32), mesh)
+
+    @jax.jit
+    def global_mean(v):
+        return v.mean(axis=0)
+
+    out = global_mean(x)
+    np.testing.assert_allclose(np.asarray(out), np.ones(2))
