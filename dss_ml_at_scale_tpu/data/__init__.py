@@ -1,0 +1,15 @@
+"""Data layer: sharded Arrow/Parquet streaming + Delta-log access.
+
+TPU-native replacement for the reference's input stack —
+Petastorm ``make_batch_reader``/``DataLoader``/``TransformSpec``
+(reference ``deep_learning/2.distributed-data-loading-petastorm.py:246-318``)
+and the deltalake-rs file listing (``:99-112``) — built on pyarrow's C++
+Parquet engine with a host-side decode worker pool, a bounded results
+queue, and double-buffered transfer to device.
+"""
+
+from .delta import DeltaTable, write_delta  # noqa: F401
+from .reader import ParquetShardReader, batch_loader, make_batch_reader  # noqa: F401
+from .sharding import RowGroupUnit, list_row_groups, shard_units  # noqa: F401
+from .transform import TransformSpec  # noqa: F401
+from .prefetch import prefetch_to_mesh  # noqa: F401

@@ -1,0 +1,121 @@
+"""Row-group transform pipeline (the TransformSpec equivalent).
+
+Petastorm's ``TransformSpec`` carries a pandas-level function plus
+``edit_fields`` declaring post-transform dtypes/shapes so the reader can
+build tensors without inspecting data (reference
+``deep_learning/2.distributed-data-loading-petastorm.py:310-318``:
+float32 (3,224,224) image + int32 label). Here the contract is columnar:
+the function maps a dict of numpy arrays (one row group) to a dict of
+numpy arrays, and ``fields`` declares the output schema the trainer can
+rely on for jit-stable shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import Callable, Mapping, Sequence
+
+import numpy as np
+
+Columnar = Mapping[str, np.ndarray]
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: np.dtype
+    shape: tuple[int, ...]  # per-row shape, () for scalar columns
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformSpec:
+    """Transform + declared output schema.
+
+    ``func`` runs on host CPU inside the reader worker pool — this is
+    deliberately where JPEG decode lives (same as the reference: decode on
+    host, ship ready tensors to the accelerator).
+    """
+
+    func: Callable[[Columnar], Columnar]
+    fields: Sequence[Field]
+
+    def __call__(self, batch: Columnar) -> dict[str, np.ndarray]:
+        out = dict(self.func(batch))
+        declared = {f.name: f for f in self.fields}
+        if set(out) != set(declared):
+            raise ValueError(
+                f"transform produced columns {sorted(out)} but declared "
+                f"{sorted(declared)}"
+            )
+        n = None
+        for name, arr in out.items():
+            f = declared[name]
+            arr = np.asarray(arr, dtype=f.dtype)
+            want = (len(arr),) + tuple(f.shape)
+            if arr.shape != want:
+                raise ValueError(
+                    f"column {name}: shape {arr.shape} != declared {want}"
+                )
+            if n is None:
+                n = len(arr)
+            elif len(arr) != n:
+                raise ValueError("transform produced ragged column lengths")
+            out[name] = arr
+        return out
+
+
+# -- ImageNet-style image pipeline (reference :282-296) ---------------------
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def decode_resize_crop(jpeg_bytes: bytes, resize: int = 256, crop: int = 224) -> np.ndarray:
+    """JPEG → float32 CHW in [0,1], shorter-side resize then center crop.
+
+    Matches torchvision's Resize(256)/CenterCrop(224)/ToTensor semantics
+    used by the reference's ``preprocess`` (``deep_learning/2...py:282-296``).
+    """
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(jpeg_bytes)).convert("RGB")
+    w, h = img.size
+    scale = resize / min(w, h)
+    img = img.resize((max(1, round(w * scale)), max(1, round(h * scale))), Image.BILINEAR)
+    w, h = img.size
+    left, top = (w - crop) // 2, (h - crop) // 2
+    img = img.crop((left, top, left + crop, top + crop))
+    arr = np.asarray(img, np.float32) / 255.0  # HWC
+    return arr.transpose(2, 0, 1)  # CHW
+
+
+def imagenet_transform_spec(
+    *,
+    content_column: str = "content",
+    label_column: str = "label_index",
+    crop: int = 224,
+    normalize: bool = True,
+) -> TransformSpec:
+    """The reference's training TransformSpec, columnar.
+
+    Emits ``image`` float32 (3,crop,crop) and ``label`` int32 — the same
+    field contract as ``deep_learning/2...py:310-318``.
+    """
+
+    def _func(batch: Columnar) -> Columnar:
+        images = np.stack(
+            [decode_resize_crop(b, crop=crop) for b in batch[content_column]]
+        )
+        if normalize:
+            images = (images - IMAGENET_MEAN[:, None, None]) / IMAGENET_STD[:, None, None]
+        labels = np.asarray(batch[label_column], np.int32)
+        return {"image": images, "label": labels}
+
+    return TransformSpec(
+        func=_func,
+        fields=[
+            Field("image", np.dtype(np.float32), (3, crop, crop)),
+            Field("label", np.dtype(np.int32), ()),
+        ],
+    )

@@ -105,10 +105,16 @@ def shard_batch_to_mesh(batch, mesh: Mesh, axis: str = "data"):
     def _place(x):
         if np.ndim(x) == 0:
             return jax.device_put(x, NamedSharding(mesh, P()))
-        if np.shape(x)[0] % mesh.shape[axis]:
+        # Each process contributes its local rows; the divisibility that
+        # matters is against the *local* slice of the axis (global size in
+        # single-process runs).
+        local_axis = mesh.shape[axis]
+        if jax.process_count() > 1 and local_axis % jax.process_count() == 0:
+            local_axis //= jax.process_count()
+        if np.shape(x)[0] % local_axis:
             raise ValueError(
-                f"leading (batch) dim {np.shape(x)[0]} not divisible by mesh "
-                f"axis '{axis}' of size {mesh.shape[axis]}"
+                f"leading (batch) dim {np.shape(x)[0]} not divisible by the "
+                f"local slice ({local_axis}) of mesh axis '{axis}'"
             )
         sharding = NamedSharding(mesh, P(axis, *([None] * (np.ndim(x) - 1))))
         if jax.process_count() > 1:

@@ -1,0 +1,100 @@
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from dss_ml_at_scale_tpu.data import DeltaTable, write_delta
+
+
+def _table(n=100, offset=0):
+    return pa.table(
+        {
+            "id": pa.array(np.arange(offset, offset + n)),
+            "x": pa.array(np.random.default_rng(n).normal(size=n)),
+            "name": pa.array([f"row{i}" for i in range(n)]),
+        }
+    )
+
+
+def test_write_and_read_roundtrip(tmp_path):
+    dt = write_delta(_table(100), tmp_path / "t", max_rows_per_file=30)
+    assert dt.num_records() == 100
+    assert len(dt.file_uris()) == 4  # 30+30+30+10
+    assert dt.version() == 0
+    adds = dt.get_add_actions()
+    assert sum(a.num_records for a in adds) == 100
+    assert all(a.size > 0 for a in adds)
+
+
+def test_append_and_overwrite(tmp_path):
+    path = tmp_path / "t"
+    write_delta(_table(50), path)
+    dt = write_delta(_table(25, offset=50), path, mode="append")
+    assert dt.num_records() == 75
+    assert dt.version() == 1
+    dt = write_delta(_table(10), path, mode="overwrite")
+    assert dt.num_records() == 10
+    assert dt.version() == 2
+    # only the overwrite's files remain visible
+    assert len(dt.file_uris()) == 1
+
+
+def test_mode_error_on_existing(tmp_path):
+    write_delta(_table(10), tmp_path / "t")
+    with pytest.raises(FileExistsError):
+        write_delta(_table(10), tmp_path / "t")
+
+
+def test_not_a_delta_table(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        DeltaTable(tmp_path)
+
+
+def test_schema_json(tmp_path):
+    dt = write_delta(_table(5), tmp_path / "t")
+    schema = dt.schema_json()
+    names = {f["name"]: f["type"] for f in schema["fields"]}
+    assert names == {"id": "long", "x": "double", "name": "string"}
+
+
+def test_reads_foreign_log_with_string_stats(tmp_path):
+    """Delta logs written by other writers carry stats as JSON strings."""
+    import pyarrow.parquet as pq
+
+    root = tmp_path / "t"
+    (root / "_delta_log").mkdir(parents=True)
+    pq.write_table(_table(42), root / "part-0.parquet")
+    actions = [
+        {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
+        {"metaData": {"id": "m", "schemaString": "{}", "format": {"provider": "parquet"}}},
+        {
+            "add": {
+                "path": "part-0.parquet",
+                "size": 1,
+                "partitionValues": {},
+                "stats": json.dumps({"numRecords": 42, "minValues": {}}),
+                "dataChange": True,
+            }
+        },
+        {"commitInfo": {"operation": "WRITE"}},
+    ]
+    with open(root / "_delta_log" / f"{0:020d}.json", "w") as f:
+        f.writelines(json.dumps(a) + "\n" for a in actions)
+    dt = DeltaTable(root)
+    assert dt.num_records() == 42
+    assert dt.file_uris() == [str(root / "part-0.parquet")]
+
+
+def test_invalid_mode_rejected(tmp_path):
+    write_delta(_table(10), tmp_path / "t")
+    with pytest.raises(ValueError, match="mode"):
+        write_delta(_table(10), tmp_path / "t", mode="Overwrite")
+
+
+def test_overwrite_refreshes_schema(tmp_path):
+    write_delta(_table(10), tmp_path / "t")
+    other = pa.table({"only_col": pa.array([1.5, 2.5])})
+    dt = write_delta(other, tmp_path / "t", mode="overwrite")
+    names = [f["name"] for f in dt.schema_json()["fields"]]
+    assert names == ["only_col"]

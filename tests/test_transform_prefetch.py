@@ -1,0 +1,57 @@
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dss_ml_at_scale_tpu.data import TransformSpec, prefetch_to_mesh
+from dss_ml_at_scale_tpu.data.transform import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    decode_resize_crop,
+    imagenet_transform_spec,
+)
+from dss_ml_at_scale_tpu.runtime import make_mesh
+
+
+def _jpeg(w, h, color=(255, 0, 0)):
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), color).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def test_decode_resize_crop_shapes():
+    for w, h in [(640, 480), (480, 640), (100, 300), (224, 224)]:
+        out = decode_resize_crop(_jpeg(w, h))
+        assert out.shape == (3, 224, 224)
+        assert out.dtype == np.float32
+        assert 0.0 <= out.min() and out.max() <= 1.0
+
+
+def test_imagenet_spec_normalizes():
+    spec = imagenet_transform_spec()
+    batch = {
+        "content": np.array([_jpeg(300, 260), _jpeg(260, 300, (0, 0, 255))], dtype=object),
+        "label_index": np.array([3, 7]),
+    }
+    out = spec(batch)
+    assert out["image"].shape == (2, 3, 224, 224)
+    assert out["label"].tolist() == [3, 7]
+    # red channel of a pure-red jpeg ≈ (1 - mean)/std after normalize
+    red = out["image"][0, 0].mean()
+    assert abs(red - (1.0 - IMAGENET_MEAN[0]) / IMAGENET_STD[0]) < 0.05
+
+
+def test_prefetch_to_mesh_shards_batches(devices8):
+    mesh = make_mesh()
+    batches = [{"x": np.full((8, 2), i, np.float32)} for i in range(6)]
+    out = list(prefetch_to_mesh(iter(batches), mesh, depth=3))
+    assert len(out) == 6
+    for i, b in enumerate(out):
+        assert float(np.asarray(b["x"]).mean()) == i
+        assert len(b["x"].sharding.device_set) == 8
+
+
+def test_prefetch_depth_validation(devices8):
+    with pytest.raises(ValueError):
+        list(prefetch_to_mesh(iter([]), make_mesh(), depth=0))
