@@ -1,0 +1,84 @@
+"""Headline benchmark: ResNet-50 training throughput, images/sec/chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md); the operative target is
+the driver-defined north star — ResNet-50 images/sec/chip vs an
+8×A100-class DDP baseline. ``vs_baseline`` is measured throughput divided
+by A100_IMG_PER_SEC (a public ~A100 ResNet-50 mixed-precision per-GPU
+figure), so 1.0 == per-chip parity with the reference-class hardware.
+
+Runs on whatever jax.devices() provides: the real TPU chip under the
+driver, or (fallback) CPU where the number is meaningless but the
+harness still exercises end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+A100_IMG_PER_SEC = 2500.0  # ResNet-50 train, mixed precision, per A100
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dss_ml_at_scale_tpu.models import ResNet50
+    from dss_ml_at_scale_tpu.parallel import ClassifierTask
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    # Reference per-rank batch is 212 (deep_learning/2...py:342); bf16
+    # ResNet-50 at 212×224×224 fits a v5e chip.
+    batch = 212 if on_accel else 8
+    image = 224 if on_accel else 64
+    steps = 10 if on_accel else 2
+
+    model = ResNet50(num_classes=1000) if on_accel else ResNet50(
+        num_classes=1000, num_filters=16, dtype=jnp.float32
+    )
+    task = ClassifierTask(model=model, tx=optax.adam(1e-5))
+
+    rng = np.random.default_rng(0)
+    host_batch = {
+        "image": rng.normal(size=(batch, image, image, 3)).astype(np.float32),
+        "label": rng.integers(0, 1000, batch).astype(np.int32),
+    }
+    state = task.init_state(jax.random.key(0), host_batch)
+    device_batch = jax.device_put(host_batch)
+    train_step = jax.jit(task.train_step, donate_argnums=0)
+
+    # Warmup: compile + 2 steady steps.
+    for _ in range(3):
+        state, metrics = train_step(state, device_batch)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = train_step(state, device_batch)
+    # Force full materialization: fetch a scalar that depends on the last
+    # step (block_until_ready alone has proven unreliable through remote
+    # device tunnels).
+    float(metrics["train_loss"])
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(ips, 2),
+                "unit": f"images/sec (batch {batch}, {jax.devices()[0].device_kind})",
+                "vs_baseline": round(ips / A100_IMG_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+"""Model zoo (Flax) + metrics. Flagship: ResNet-50 image classifier."""
+
+from .resnet import ResNet, ResNet18, ResNet50, ResNet101  # noqa: F401
+from .metrics import cross_entropy_loss, multiclass_accuracy  # noqa: F401

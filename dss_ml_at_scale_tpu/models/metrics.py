@@ -1,0 +1,21 @@
+"""Loss/metric functions, written to be globally correct under SPMD.
+
+The reference computes cross-entropy + torchmetrics multiclass accuracy
+per rank (``deep_learning/2...py:167-208``); here every reduction happens
+inside the jitted batch-sharded program, so means are automatically global
+across chips — no separate metric-sync pass.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def multiclass_accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Top-1 accuracy (the reference's torchmetrics Accuracy, num_classes=1000)."""
+    return (jnp.argmax(logits, axis=-1) == labels).mean()

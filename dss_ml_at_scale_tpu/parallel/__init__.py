@@ -1,0 +1,8 @@
+"""Distribution strategies: DP trainer, HPO executor, group-apply engine."""
+
+from .trainer import (  # noqa: F401
+    ClassifierTask,
+    Trainer,
+    TrainerConfig,
+    TrainState,
+)

@@ -1,0 +1,417 @@
+"""Data-parallel trainer: explicit jitted step loop over a device mesh.
+
+Replaces the reference's PyTorch-Lightning ``Trainer(strategy="ddp")`` +
+``TorchDistributor`` stack (reference
+``deep_learning/2.distributed-data-loading-petastorm.py:351-415``) with the
+TPU-native shape: one jitted train step compiled over a batch-sharded mesh.
+Gradient averaging needs no NCCL and no ``psum`` written by hand — the loss
+is a mean over the *global* (sharded) batch, so XLA emits the cross-chip
+reduction on ICI as part of backprop.
+
+Semantics carried over from the reference driver:
+
+- epoch boundaries by step count on an infinite reader:
+  ``steps_per_epoch = rows // (batch × world)`` (``:387-388``), the
+  Lightning ``limit_train_batches`` trick made explicit;
+- eval every epoch, capped at ``limit_val_batches`` (``:402-405``);
+- no sanity-val prologue (``num_sanity_val_steps=0``);
+- per-epoch wall-clock + throughput reporting (``:183-188``);
+- checkpoint each epoch, best tracked on a val metric, best path returned
+  (``:407-415``) — here via Orbax sharded checkpoints with resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterable, Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.core import FrozenDict
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data.prefetch import prefetch_to_mesh
+from ..models.metrics import cross_entropy_loss, multiclass_accuracy
+from ..runtime.mesh import make_mesh
+from ..runtime.topology import local_topology
+
+log = logging.getLogger(__name__)
+
+Batch = Mapping[str, Any]
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+@dataclasses.dataclass
+class ClassifierTask:
+    """Image-classification task: Flax model + optax optimizer.
+
+    The functional analogue of the reference's
+    ``ImageNetClassificationModel(pl.LightningModule)``
+    (``deep_learning/2...py:135-208``): Adam(lr=1e-5) default, softmax
+    cross-entropy, top-1 accuracy on eval.
+
+    Expects batches with ``image`` (NHWC or NCHW float32) and ``label``
+    (int). NCHW input is transposed once on device — the decode pipeline
+    produces CHW rows for torchvision parity, TPU convs want NHWC.
+    """
+
+    model: Any
+    tx: optax.GradientTransformation | None = None
+    learning_rate: float = 1e-5
+    image_key: str = "image"
+    label_key: str = "label"
+
+    def __post_init__(self):
+        if self.tx is None:
+            self.tx = optax.adam(self.learning_rate)
+
+    # -- state ------------------------------------------------------------
+
+    def init_state(self, rng, sample_batch: Batch) -> TrainState:
+        images = self._images(sample_batch)
+        variables = self.model.init(rng, images[:1], train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", FrozenDict())
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=self.tx.init(params),
+        )
+
+    def _images(self, batch: Batch):
+        x = jnp.asarray(batch[self.image_key])
+        if x.ndim == 4 and x.shape[1] in (1, 3) and x.shape[-1] not in (1, 3):
+            x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
+        return x
+
+    # -- steps (pure; jitted by the Trainer) ------------------------------
+
+    def train_step(self, state: TrainState, batch: Batch):
+        images, labels = self._images(batch), jnp.asarray(batch[self.label_key])
+
+        def loss_fn(params):
+            logits, updates = self.model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            loss = cross_entropy_loss(logits, labels)
+            return loss, (logits, updates["batch_stats"])
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "train_loss": loss,
+            "train_acc": multiclass_accuracy(logits, labels),
+        }
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=new_stats,
+                opt_state=new_opt,
+            ),
+            metrics,
+        )
+
+    def eval_step(self, state: TrainState, batch: Batch):
+        images, labels = self._images(batch), jnp.asarray(batch[self.label_key])
+        logits = self.model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images,
+            train=False,
+        )
+        return {
+            "val_loss": cross_entropy_loss(logits, labels),
+            "val_acc": multiclass_accuracy(logits, labels),
+        }
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    max_epochs: int = 2                      # reference MAX_EPOCHS (2...py:343)
+    steps_per_epoch: int | None = None       # else rows // (batch × world)
+    total_train_rows: int | None = None
+    limit_val_batches: int | None = 5        # reference :405
+    log_every_steps: int = 10
+    checkpoint_dir: str | None = None
+    keep_checkpoints: int = 2
+    best_metric: str = "val_acc"
+    best_mode: str = "max"
+    resume: bool = False
+    prefetch_depth: int = 2
+
+
+@dataclasses.dataclass
+class FitResult:
+    state: TrainState
+    best_checkpoint_step: int | None
+    best_metric_value: float | None
+    history: list[dict]
+    best_checkpoint_path: str | None = None
+
+
+class Trainer:
+    """Explicit epoch/step loop, one compiled train step, mesh-sharded."""
+
+    def __init__(self, config: TrainerConfig, mesh: Mesh | None = None,
+                 tracker=None):
+        self.config = config
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.tracker = tracker
+        self.topology = local_topology()
+
+    # -- accounting -------------------------------------------------------
+
+    def _steps_per_epoch(self, per_process_batch: int) -> int:
+        cfg = self.config
+        if cfg.steps_per_epoch is not None:
+            return cfg.steps_per_epoch
+        if cfg.total_train_rows is None:
+            raise ValueError(
+                "TrainerConfig needs steps_per_epoch or total_train_rows "
+                "(row counts come from DeltaTable.num_records())"
+            )
+        global_batch = per_process_batch * self.topology.process_count
+        steps = cfg.total_train_rows // global_batch
+        if steps == 0:
+            raise ValueError(
+                f"total_train_rows={cfg.total_train_rows} < global batch "
+                f"{global_batch}; no full step per epoch"
+            )
+        return steps
+
+    # -- fit --------------------------------------------------------------
+
+    def fit(
+        self,
+        task,
+        train_data: Iterable[Batch],
+        val_data_factory: Callable[[], Iterable[Batch]] | None = None,
+        *,
+        rng: jax.Array | None = None,
+        state: TrainState | None = None,
+    ) -> FitResult:
+        cfg = self.config
+        mesh = self.mesh
+        rng = rng if rng is not None else jax.random.key(0)
+
+        train_iter = iter(train_data)
+        first = next(train_iter)
+        per_process_batch = len(next(iter(first.values())))
+        steps_per_epoch = self._steps_per_epoch(per_process_batch)
+
+        replicated = NamedSharding(mesh, P())
+        if state is None:
+            state = task.init_state(rng, first)
+        state = jax.device_put(state, replicated)
+
+        train_step = jax.jit(task.train_step, donate_argnums=0,
+                             out_shardings=(replicated, replicated))
+        eval_step = jax.jit(task.eval_step, out_shardings=replicated)
+
+        # Track-best only matters when something produces the metric.
+        manager = self._checkpoint_manager(use_best=val_data_factory is not None)
+        start_epoch = 0
+        if manager is not None and cfg.resume and manager.latest_step() is not None:
+            state = self._restore(manager, state)
+            start_epoch = int(state.step) // steps_per_epoch
+
+        def batches():
+            yield first
+            yield from train_iter
+
+        device_batches = prefetch_to_mesh(
+            batches(), mesh, depth=cfg.prefetch_depth
+        )
+
+        history: list[dict] = []
+        best_value, best_step = self._prior_best(manager)
+        sign = 1.0 if cfg.best_mode == "max" else -1.0
+        step = int(state.step)  # host-side mirror, synced once before the loop
+        data_exhausted = False
+
+        for epoch in range(start_epoch, cfg.max_epochs):
+            if data_exhausted:
+                log.warning(
+                    "train data exhausted at step %d; stopping before epoch %d "
+                    "of %d", step, epoch, cfg.max_epochs,
+                )
+                break
+            t0 = time.perf_counter()
+            metrics = {}
+            epoch_steps = 0
+            for _ in range(steps_per_epoch):
+                try:
+                    batch = next(device_batches)
+                except StopIteration:
+                    data_exhausted = True
+                    break
+                state, metrics = train_step(state, batch)
+                epoch_steps += 1
+                step += 1  # host-side mirror of state.step: no device sync
+                if step % cfg.log_every_steps == 0:
+                    self._log({k: float(v) for k, v in metrics.items()}, step)
+            if epoch_steps == 0:
+                break
+            jax.block_until_ready(state.params)
+            dt = time.perf_counter() - t0
+            epoch_summary = {
+                "epoch": epoch,
+                "epoch_time_s": dt,
+                "images_per_sec": epoch_steps
+                * per_process_batch
+                * self.topology.process_count
+                / dt,
+                **{k: float(v) for k, v in metrics.items()},
+            }
+
+            if val_data_factory is not None:
+                epoch_summary.update(self._evaluate(eval_step, state, val_data_factory))
+
+            history.append(epoch_summary)
+            self._log(
+                {k: v for k, v in epoch_summary.items() if k != "epoch"}, step
+            )
+
+            metric_val = epoch_summary.get(cfg.best_metric)
+            is_best = metric_val is not None and (
+                best_value is None or sign * metric_val > sign * best_value
+            )
+            if is_best:
+                best_value, best_step = metric_val, step
+            if manager is not None:
+                if val_data_factory is not None:
+                    # With best-tracking on, every save needs the metric or
+                    # orbax retention stops pruning; a missing value ranks
+                    # worst so it never wins "best".
+                    save_metrics = {
+                        cfg.best_metric: metric_val
+                        if metric_val is not None
+                        else sign * float("-inf")
+                    }
+                else:
+                    save_metrics = None
+                manager.save(
+                    step,
+                    args=_ocp().args.StandardSave(_to_pytree(state)),
+                    metrics=save_metrics,
+                )
+        if manager is not None:
+            manager.wait_until_finished()
+
+        return FitResult(
+            state=state,
+            best_checkpoint_step=best_step,
+            best_metric_value=best_value,
+            history=history,
+            best_checkpoint_path=(
+                str(Path(cfg.checkpoint_dir) / str(best_step))
+                if manager is not None and best_step is not None
+                else None
+            ),
+        )
+
+    # -- eval -------------------------------------------------------------
+
+    def _evaluate(self, eval_step, state, val_data_factory) -> dict:
+        cfg = self.config
+        totals: dict[str, float] = {}
+        count = 0
+        val_data = val_data_factory()
+        try:
+            # Limit BEFORE prefetch so no extra batches are decoded and
+            # shipped to HBM just to be discarded.
+            source = iter(val_data)
+            if cfg.limit_val_batches is not None:
+                source = itertools.islice(source, cfg.limit_val_batches)
+            val_batches = prefetch_to_mesh(
+                source, self.mesh, depth=cfg.prefetch_depth
+            )
+            for batch in val_batches:
+                m = eval_step(state, batch)
+                for k, v in m.items():
+                    totals[k] = totals.get(k, 0.0) + float(v)
+                count += 1
+        finally:
+            # Stop streaming readers eagerly — limit_val_batches may leave
+            # the source mid-stream with worker threads still decoding.
+            stop = getattr(val_data, "stop", None)
+            if callable(stop):
+                stop()
+        return {k: v / max(count, 1) for k, v in totals.items()}
+
+    # -- checkpointing ----------------------------------------------------
+
+    def _checkpoint_manager(self, use_best: bool):
+        cfg = self.config
+        if cfg.checkpoint_dir is None:
+            return None
+        ocp = _ocp()
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=cfg.keep_checkpoints,
+            # best_fn only when metrics will actually be saved: with best_fn
+            # configured and metrics=None, orbax keeps every step (verified
+            # against the installed version) and retention silently breaks.
+            best_fn=(lambda m: m[cfg.best_metric]) if use_best else None,
+            best_mode=cfg.best_mode,
+        )
+        return ocp.CheckpointManager(Path(cfg.checkpoint_dir).absolute(), options=options)
+
+    def _prior_best(self, manager) -> tuple[float | None, int | None]:
+        """Recover best-so-far from a resumed manager so a worse post-resume
+        epoch can't claim best_checkpoint_path."""
+        if manager is None or not self.config.resume:
+            return None, None
+        try:
+            best_step = manager.best_step()
+            if best_step is None:
+                return None, None
+            all_metrics = manager.metrics(best_step)
+            return (all_metrics or {}).get(self.config.best_metric), best_step
+        except Exception:
+            return None, None
+
+    def _restore(self, manager, state: TrainState) -> TrainState:
+        ocp = _ocp()
+        restored = manager.restore(
+            manager.latest_step(),
+            args=ocp.args.StandardRestore(_to_pytree(state)),
+        )
+        return TrainState(**restored)
+
+    def _log(self, metrics: dict, step: int) -> None:
+        if self.tracker is not None:
+            self.tracker.log_metrics(metrics, step)
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def _to_pytree(state: TrainState) -> dict:
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+    }

@@ -1,0 +1,3 @@
+"""Experiment tracking: run/param/metric/artifact store."""
+
+from .store import RunStore, start_run  # noqa: F401

@@ -1,0 +1,163 @@
+"""Lightweight experiment tracking (the MLflow-wiring replacement).
+
+The reference threads MLflow through every track: experiment pinning, a
+host/token env relay so Spark workers can log, ``MLFlowLogger`` for
+Lightning, and autologged HPO trials (reference
+``deep_learning/2.distributed-data-loading-petastorm.py:56-75,357-365``,
+``hyperopt/1. hyperopt.py:130-136``, ``group_apply/_resources/00-setup.py:71``).
+
+Here tracking is a plain directory store — no server, no token relay:
+
+    <root>/<experiment>/<run_id>/
+        meta.json       run name/status/times
+        params.json     flat key->value
+        metrics.jsonl   {"name","value","step","ts"} per line
+        artifacts/      files
+
+Multi-host discipline matches the build spec (SURVEY.md §5.5): metrics
+are already globally-reduced inside SPMD programs, so **only process 0
+writes**; non-coordinator processes get a no-op store. An optional
+``to_mlflow`` export bridges to a real MLflow server when the client
+library is installed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+import time
+import uuid
+from pathlib import Path
+from typing import Any, Mapping
+
+import jax
+
+
+def _now() -> float:
+    return time.time()
+
+
+class RunStore:
+    """One run's param/metric/artifact sink. Cheap, append-only, crash-safe."""
+
+    def __init__(
+        self,
+        root: str | os.PathLike,
+        experiment: str,
+        run_id: str | None = None,
+        run_name: str | None = None,
+        *,
+        coordinator_only: bool = True,
+        resume: bool = False,
+    ):
+        self.active = not coordinator_only or jax.process_index() == 0
+        self.run_id = run_id or uuid.uuid4().hex[:12]
+        self.path = Path(root) / experiment / self.run_id
+        if not self.active:
+            return
+        if self.path.exists() and not resume and run_id is not None:
+            raise FileExistsError(f"run already exists: {self.path}")
+        (self.path / "artifacts").mkdir(parents=True, exist_ok=True)
+        self._metrics = open(self.path / "metrics.jsonl", "a", encoding="utf-8")
+        meta = {"experiment": experiment, "run_id": self.run_id,
+                "run_name": run_name or self.run_id, "status": "RUNNING",
+                "start_time": _now()}
+        self._write_json("meta.json", meta)
+
+    # -- logging ----------------------------------------------------------
+
+    def log_params(self, params: Mapping[str, Any]) -> None:
+        if not self.active:
+            return
+        merged = {}
+        f = self.path / "params.json"
+        if f.exists():
+            merged = json.loads(f.read_text())
+        merged.update({k: _jsonable(v) for k, v in params.items()})
+        self._write_json("params.json", merged)
+
+    def log_metrics(self, metrics: Mapping[str, Any], step: int | None = None) -> None:
+        if not self.active:
+            return
+        ts = _now()
+        for name, value in metrics.items():
+            self._metrics.write(
+                json.dumps({"name": name, "value": float(value), "step": step, "ts": ts})
+                + "\n"
+            )
+        self._metrics.flush()
+
+    def log_artifact(self, src: str | os.PathLike, name: str | None = None) -> None:
+        if not self.active:
+            return
+        src = Path(src)
+        shutil.copy2(src, self.path / "artifacts" / (name or src.name))
+
+    def log_text(self, text: str, name: str) -> None:
+        if not self.active:
+            return
+        (self.path / "artifacts" / name).write_text(text)
+
+    def finish(self, status: str = "FINISHED") -> None:
+        if not self.active:
+            return
+        meta = json.loads((self.path / "meta.json").read_text())
+        meta.update(status=status, end_time=_now())
+        self._write_json("meta.json", meta)
+        self._metrics.close()
+
+    # -- reading back -----------------------------------------------------
+
+    def metrics(self) -> list[dict]:
+        if not self.active:
+            return []
+        with open(self.path / "metrics.jsonl", encoding="utf-8") as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    def params(self) -> dict:
+        f = self.path / "params.json"
+        return json.loads(f.read_text()) if self.active and f.exists() else {}
+
+    def _write_json(self, name: str, obj) -> None:
+        tmp = self.path / (name + ".tmp")
+        tmp.write_text(json.dumps(obj, indent=2))
+        tmp.replace(self.path / name)
+
+    # -- optional MLflow bridge ------------------------------------------
+
+    def to_mlflow(self, tracking_uri: str | None = None) -> None:
+        """Export this run to an MLflow server, if mlflow is installed."""
+        if not self.active:
+            return
+        import mlflow  # optional dependency, import deferred
+
+        if tracking_uri:
+            mlflow.set_tracking_uri(tracking_uri)
+        meta = json.loads((self.path / "meta.json").read_text())
+        mlflow.set_experiment(meta["experiment"])
+        with mlflow.start_run(run_name=meta["run_name"]):
+            mlflow.log_params(self.params())
+            for m in self.metrics():
+                mlflow.log_metric(m["name"], m["value"], step=m["step"] or 0)
+
+
+@contextlib.contextmanager
+def start_run(root, experiment, **kwargs):
+    """``with start_run(...) as run:`` — mirrors ``mlflow.start_run()``."""
+    run = RunStore(root, experiment, **kwargs)
+    try:
+        yield run
+        run.finish("FINISHED")
+    except BaseException:
+        run.finish("FAILED")
+        raise
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return v
+    except TypeError:
+        return str(v)

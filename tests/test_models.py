@@ -1,0 +1,48 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dss_ml_at_scale_tpu.models import ResNet, ResNet50
+from dss_ml_at_scale_tpu.models.resnet import ResNetBlock
+
+
+def tiny_resnet(num_classes=10):
+    return ResNet(
+        stage_sizes=[1, 1],
+        block_cls=ResNetBlock,
+        num_classes=num_classes,
+        num_filters=8,
+        dtype=jnp.float32,
+    )
+
+
+def test_tiny_resnet_forward_shapes():
+    model = tiny_resnet()
+    x = jnp.ones((2, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    assert "batch_stats" in variables
+
+
+def test_train_mode_updates_batch_stats():
+    model = tiny_resnet()
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 32, 32, 3)), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    _, updates = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    leaves_before = jax.tree_util.tree_leaves(variables["batch_stats"])
+    leaves_after = jax.tree_util.tree_leaves(updates["batch_stats"])
+    assert any(
+        not np.allclose(a, b) for a, b in zip(leaves_before, leaves_after)
+    )
+
+
+def test_resnet50_param_count():
+    """ResNet-50 must match the canonical ~25.6M parameters."""
+    model = ResNet50(num_classes=1000)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.ones((1, 224, 224, 3)), train=False)
+    )
+    n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(variables["params"]))
+    assert abs(n - 25_557_032) / 25_557_032 < 0.01, n

@@ -1,0 +1,39 @@
+import json
+
+import pytest
+
+from dss_ml_at_scale_tpu.tracking import RunStore, start_run
+
+
+def test_run_store_roundtrip(tmp_path):
+    store = RunStore(tmp_path, "exp1", run_name="my-run")
+    store.log_params({"lr": 1e-5, "batch": 212, "obj": {"a": 1}})
+    store.log_metrics({"loss": 2.5}, step=1)
+    store.log_metrics({"loss": 1.5, "acc": 0.7}, step=2)
+    store.finish()
+
+    assert store.params()["lr"] == 1e-5
+    ms = store.metrics()
+    assert [m["value"] for m in ms if m["name"] == "loss"] == [2.5, 1.5]
+    meta = json.loads((store.path / "meta.json").read_text())
+    assert meta["status"] == "FINISHED"
+    assert meta["run_name"] == "my-run"
+
+
+def test_start_run_context_marks_failed(tmp_path):
+    with pytest.raises(RuntimeError):
+        with start_run(tmp_path, "exp") as run:
+            run.log_metrics({"x": 1.0})
+            raise RuntimeError("boom")
+    meta = json.loads((run.path / "meta.json").read_text())
+    assert meta["status"] == "FAILED"
+
+
+def test_artifact_logging(tmp_path):
+    src = tmp_path / "model.txt"
+    src.write_text("weights")
+    store = RunStore(tmp_path / "store", "exp")
+    store.log_artifact(src)
+    store.log_text("hello", "notes.md")
+    assert (store.path / "artifacts" / "model.txt").read_text() == "weights"
+    assert (store.path / "artifacts" / "notes.md").read_text() == "hello"

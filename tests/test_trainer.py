@@ -1,0 +1,131 @@
+import numpy as np
+import pytest
+
+from dss_ml_at_scale_tpu.parallel import ClassifierTask, Trainer, TrainerConfig
+from dss_ml_at_scale_tpu.runtime import make_mesh
+from dss_ml_at_scale_tpu.tracking import RunStore
+
+from test_models import tiny_resnet
+
+
+def synthetic_batches(n_batches, batch=16, classes=4, seed=0):
+    """Learnable task: class determined by which quadrant is bright."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        labels = rng.integers(0, classes, batch)
+        imgs = rng.normal(0, 0.1, (batch, 32, 32, 3)).astype(np.float32)
+        for i, c in enumerate(labels):
+            r, col = divmod(int(c), 2)
+            imgs[i, r * 16 : (r + 1) * 16, col * 16 : (col + 1) * 16, :] += 1.0
+        out.append({"image": imgs, "label": labels.astype(np.int32)})
+    return out
+
+
+@pytest.fixture(scope="module")
+def task():
+    import optax
+
+    return ClassifierTask(model=tiny_resnet(num_classes=4), tx=optax.adam(1e-2))
+
+
+def test_loss_decreases_on_learnable_task(devices8, task):
+    mesh = make_mesh()
+    batches = synthetic_batches(40)
+    trainer = Trainer(
+        TrainerConfig(max_epochs=2, steps_per_epoch=20, log_every_steps=1000),
+        mesh=mesh,
+    )
+    result = trainer.fit(task, iter(batches))
+    assert len(result.history) == 2
+    assert result.history[1]["train_loss"] < result.history[0]["train_loss"]
+    assert result.history[1]["train_acc"] > 0.5
+
+
+def test_eval_and_best_tracking(devices8, task, tmp_path):
+    mesh = make_mesh()
+    trainer = Trainer(
+        TrainerConfig(
+            max_epochs=2,
+            steps_per_epoch=10,
+            limit_val_batches=3,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            best_metric="val_acc",
+        ),
+        mesh=mesh,
+    )
+    result = trainer.fit(
+        task,
+        iter(synthetic_batches(20)),
+        val_data_factory=lambda: synthetic_batches(5, seed=7),
+    )
+    assert result.best_metric_value is not None
+    assert result.best_checkpoint_step in (10, 20)
+    assert "val_acc" in result.history[-1]
+    assert (tmp_path / "ckpt").exists()
+
+
+def test_resume_from_checkpoint(devices8, task, tmp_path):
+    mesh = make_mesh()
+    cfg = dict(
+        steps_per_epoch=5,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        limit_val_batches=2,
+    )
+    t1 = Trainer(TrainerConfig(max_epochs=1, **cfg), mesh=mesh)
+    r1 = t1.fit(task, iter(synthetic_batches(10)),
+                val_data_factory=lambda: synthetic_batches(2, seed=7))
+    assert int(r1.state.step) == 5
+
+    t2 = Trainer(TrainerConfig(max_epochs=2, resume=True, **cfg), mesh=mesh)
+    r2 = t2.fit(task, iter(synthetic_batches(10)),
+                val_data_factory=lambda: synthetic_batches(2, seed=7))
+    # resumed from step 5 (epoch 1), ran exactly one more epoch
+    assert int(r2.state.step) == 10
+    assert len(r2.history) == 1
+
+
+def test_steps_per_epoch_accounting(devices8, task):
+    trainer = Trainer(
+        TrainerConfig(max_epochs=1, total_train_rows=320), mesh=make_mesh()
+    )
+    result = trainer.fit(task, iter(synthetic_batches(30)))
+    # 320 rows // (16 batch × 1 process) = 20 steps
+    assert int(result.state.step) == 20
+
+
+def test_rows_smaller_than_batch_raises(devices8, task):
+    trainer = Trainer(
+        TrainerConfig(max_epochs=1, total_train_rows=8), mesh=make_mesh()
+    )
+    with pytest.raises(ValueError, match="global batch"):
+        trainer.fit(task, iter(synthetic_batches(2)))
+
+
+def test_trainer_logs_to_tracker(devices8, task, tmp_path):
+    store = RunStore(tmp_path, "exp", run_name="t")
+    trainer = Trainer(
+        TrainerConfig(max_epochs=1, steps_per_epoch=5, log_every_steps=1),
+        mesh=make_mesh(),
+        tracker=store,
+    )
+    trainer.fit(task, iter(synthetic_batches(5)))
+    store.finish()
+    names = {m["name"] for m in store.metrics()}
+    assert {"train_loss", "train_acc", "images_per_sec"} <= names
+
+
+def test_checkpoint_retention_without_val(devices8, task, tmp_path):
+    """keep_checkpoints must prune even when no val metric is produced."""
+    trainer = Trainer(
+        TrainerConfig(
+            max_epochs=4,
+            steps_per_epoch=2,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            keep_checkpoints=2,
+        ),
+        mesh=make_mesh(),
+    )
+    trainer.fit(task, iter(synthetic_batches(10)))
+    kept = [p for p in (tmp_path / "ckpt").iterdir() if p.name.isdigit()]
+    assert len(kept) == 2
