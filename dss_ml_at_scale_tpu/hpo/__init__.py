@@ -1,0 +1,21 @@
+"""Hyperparameter optimization: search spaces, TPE, fmin, trials.
+
+Hyperopt-compatible capability surface (the reference drives hyperopt in
+all three tracks: ``hyperopt/1. hyperopt.py``, ``hyperopt/2...py``, and
+nested inside the per-SKU UDF in
+``group_apply/02_Fine_Grained_Demand_Forecasting.py:435-469``):
+``fmin`` + ``tpe.suggest`` + ``hp.*`` spaces + ``Trials`` +
+``STATUS_OK`` protocol, with seeded ``rstate``. Distributed execution
+(the SparkTrials replacement) lives in
+:mod:`dss_ml_at_scale_tpu.parallel.trials` and plugs in as ``trials=``.
+"""
+
+from . import hp  # noqa: F401
+from .fmin import (  # noqa: F401
+    STATUS_FAIL,
+    STATUS_OK,
+    Trials,
+    fmin,
+)
+from .space import sample_space, space_eval  # noqa: F401
+from .tpe import TPE, random_suggest, tpe_suggest  # noqa: F401

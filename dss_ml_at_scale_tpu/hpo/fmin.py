@@ -1,0 +1,131 @@
+"""``fmin`` driver loop + ``Trials`` stores.
+
+Reference surface: ``fmin(objective, space, algo=tpe.suggest,
+max_evals=N, trials=..., rstate=np.random.default_rng(seed))`` returning
+the best point dict (``hyperopt/1. hyperopt.py:94-103``,
+``group_apply/02...py:461-469``). Objectives return either a bare loss or
+``{'loss': x, 'status': STATUS_OK, ...}``.
+
+SparkTrials semantics preserved: a raising objective marks its trial
+``fail`` and the sweep continues (per-trial failure isolation,
+SURVEY.md §5.3); distributed execution is a ``Trials`` subclass
+(:class:`dss_ml_at_scale_tpu.parallel.trials.DeviceTrials`) that overlaps
+up to ``parallelism`` evaluations, exactly how SparkTrials rides Spark.
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from .space import space_eval
+from .tpe import tpe_suggest
+
+STATUS_OK = "ok"
+STATUS_FAIL = "fail"
+
+
+class Trials:
+    """Sequential trial store + executor (hyperopt's plain ``Trials``)."""
+
+    def __init__(self):
+        self.trials: list[dict] = []
+
+    # -- store ------------------------------------------------------------
+
+    @property
+    def results(self) -> list[dict]:
+        return [t["result"] for t in self.trials]
+
+    @property
+    def losses(self) -> list[float | None]:
+        return [t["result"].get("loss") for t in self.trials]
+
+    @property
+    def best_trial(self) -> dict:
+        ok = [t for t in self.trials if t["result"].get("status") == STATUS_OK]
+        if not ok:
+            raise ValueError("no successful trials")
+        return min(ok, key=lambda t: t["result"]["loss"])
+
+    def argmin(self) -> dict:
+        return dict(self.best_trial["point"])
+
+    def _history(self) -> list[tuple[dict, float]]:
+        return [
+            (t["point"], t["result"]["loss"])
+            for t in self.trials
+            if t["result"].get("status") == STATUS_OK
+        ]
+
+    def _record(self, tid, point, result, t0) -> None:
+        self.trials.append(
+            {
+                "tid": tid,
+                "point": point,
+                "result": result,
+                "book_time": t0,
+                "duration": time.time() - t0,
+            }
+        )
+
+    # -- execution (overridden by distributed stores) ---------------------
+
+    def run(self, objective, space, algo, max_evals, rng, tracker=None) -> None:
+        for tid in range(len(self.trials), max_evals):
+            point = algo(space, self._history(), rng)
+            t0 = time.time()
+            result = _call_objective(objective, space, point)
+            self._record(tid, point, result, t0)
+            if tracker is not None:
+                _log_trial(tracker, tid, point, result)
+
+
+def _call_objective(objective, space, point) -> dict:
+    # Protocol violations (missing/non-numeric loss) fail the TRIAL, not the
+    # sweep — same isolation as an objective that raises.
+    try:
+        out = objective(space_eval(space, point))
+        if isinstance(out, Mapping):
+            result = dict(out)
+            result.setdefault("status", STATUS_OK)
+            if result["status"] == STATUS_OK:
+                result["loss"] = float(result["loss"])
+            return result
+        return {"loss": float(out), "status": STATUS_OK}
+    except Exception:
+        return {"status": STATUS_FAIL, "error": traceback.format_exc()}
+
+
+def _log_trial(tracker, tid, point, result) -> None:
+    metrics = {"trial": float(tid)}
+    if result.get("loss") is not None:
+        metrics["loss"] = result["loss"]
+    tracker.log_metrics(metrics, step=tid)
+    tracker.log_params({f"trial_{tid}": point})
+
+
+def fmin(
+    fn: Callable[[Any], Any],
+    space,
+    algo=tpe_suggest,
+    max_evals: int = 100,
+    trials: Trials | None = None,
+    rstate: np.random.Generator | int | None = None,
+    tracker=None,
+    return_argmin: bool = True,
+):
+    """Minimize ``fn`` over ``space``. Returns the best point dict."""
+    trials = trials if trials is not None else Trials()
+    rng = (
+        rstate
+        if isinstance(rstate, np.random.Generator)
+        else np.random.default_rng(rstate)
+    )
+    trials.run(fn, space, algo, max_evals, rng, tracker=tracker)
+    if return_argmin:
+        return trials.argmin()
+    return trials

@@ -6,3 +6,4 @@ from .trainer import (  # noqa: F401
     TrainerConfig,
     TrainState,
 )
+from .trials import DeviceTrials  # noqa: F401
