@@ -94,8 +94,13 @@ def _call_objective(objective, space, point) -> dict:
             result.setdefault("status", STATUS_OK)
             if result["status"] == STATUS_OK:
                 result["loss"] = float(result["loss"])
-            return result
-        return {"loss": float(out), "status": STATUS_OK}
+        else:
+            result = {"loss": float(out), "status": STATUS_OK}
+        # A diverged objective (NaN/inf loss) must not win argmin — NaN
+        # poisons min() comparisons — nor feed the TPE surrogate.
+        if result["status"] == STATUS_OK and not np.isfinite(result["loss"]):
+            return {"status": STATUS_FAIL, "error": f"non-finite loss {result['loss']}"}
+        return result
     except Exception:
         return {"status": STATUS_FAIL, "error": traceback.format_exc()}
 

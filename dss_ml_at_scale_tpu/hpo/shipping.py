@@ -71,6 +71,7 @@ def broadcast(value) -> Broadcast:
 
 _cache: dict[str, dict[str, np.ndarray]] = {}
 _cache_lock = threading.Lock()
+_key_locks: dict[str, threading.Lock] = {}
 
 
 def save_shared(path: str | os.PathLike, **arrays: np.ndarray) -> str:
@@ -86,18 +87,26 @@ def load_shared(path: str | os.PathLike, cache: bool = True) -> dict[str, np.nda
     """Load arrays saved by :func:`save_shared`; cached once per process so
     concurrent trials don't re-read gigabytes from the shared FS."""
     key = str(path)
-    if cache:
+    if not cache:
+        with np.load(key) as npz:
+            return {name: npz[name] for name in npz.files}
+    # Per-key lock held across the read: when N trial threads race on first
+    # access, exactly one pays the (multi-GB) I/O and all N share one dict —
+    # the whole point of this regime. The global lock only guards the maps.
+    with _cache_lock:
+        key_lock = _key_locks.setdefault(key, threading.Lock())
+    with key_lock:
         with _cache_lock:
             if key in _cache:
                 return _cache[key]
-    with np.load(key) as npz:
-        data = {name: npz[name] for name in npz.files}
-    if cache:
+        with np.load(key) as npz:
+            data = {name: npz[name] for name in npz.files}
         with _cache_lock:
             _cache[key] = data
-    return data
+        return data
 
 
 def clear_shared_cache() -> None:
     with _cache_lock:
         _cache.clear()
+        _key_locks.clear()

@@ -64,9 +64,11 @@ class TPE:
         lo, hi = p.latent_bounds
         prior_mu, prior_sigma = self._prior(p)
 
-        cands = self._sample_mixture(good, prior_mu, prior_sigma, lo, hi, rng)
-        score_good = self._log_pdf_mixture(cands, good, prior_mu, prior_sigma, lo, hi)
-        score_bad = self._log_pdf_mixture(cands, bad, prior_mu, prior_sigma, lo, hi)
+        good_mix = self._mixture(good, prior_mu, prior_sigma)
+        bad_mix = self._mixture(bad, prior_mu, prior_sigma)
+        cands = self._sample_mixture(good_mix, lo, hi, rng)
+        score_good = self._log_pdf_mixture(cands, good_mix)
+        score_bad = self._log_pdf_mixture(cands, bad_mix)
         return float(cands[np.argmax(score_good - score_bad)])
 
     def _prior(self, p: Param) -> tuple[float, float]:
@@ -93,24 +95,22 @@ class TPE:
         widths[order] = widths_sorted
         return widths
 
-    def _sample_mixture(self, mus, prior_mu, prior_sigma, lo, hi, rng):
+    def _mixture(self, mus, prior_mu, prior_sigma):
+        """Observations + prior as one Parzen mixture (mus, sigmas, weights)."""
+        bw = self._bandwidths(mus, prior_sigma) if len(mus) else np.empty(0)
         mus_all = np.concatenate([mus, [prior_mu]])
-        sigmas_all = np.concatenate([self._bandwidths(mus, prior_sigma), [prior_sigma]])
-        weights = np.concatenate(
-            [np.ones(len(mus)), [self.prior_weight]]
-        )
-        weights /= weights.sum()
+        sigmas_all = np.concatenate([bw, [prior_sigma]])
+        weights = np.concatenate([np.ones(len(mus)), [self.prior_weight]])
+        return mus_all, sigmas_all, weights / weights.sum()
+
+    def _sample_mixture(self, mix, lo, hi, rng):
+        mus_all, sigmas_all, weights = mix
         comp = rng.choice(len(mus_all), size=self.n_candidates, p=weights)
         z = rng.normal(mus_all[comp], sigmas_all[comp])
         return np.clip(z, lo, hi)
 
-    def _log_pdf_mixture(self, x, mus, prior_mu, prior_sigma, lo, hi):
-        mus_all = np.concatenate([mus, [prior_mu]])
-        sigmas_all = np.concatenate(
-            [self._bandwidths(mus, prior_sigma) if len(mus) else np.empty(0), [prior_sigma]]
-        )
-        weights = np.concatenate([np.ones(len(mus)), [self.prior_weight]])
-        weights /= weights.sum()
+    def _log_pdf_mixture(self, x, mix):
+        mus_all, sigmas_all, weights = mix
         x = x[:, None]
         log_comp = (
             -0.5 * ((x - mus_all[None, :]) / sigmas_all[None, :]) ** 2

@@ -1,0 +1,41 @@
+"""JAX numerical kernels for the time-series track.
+
+TPU-native replacement for the statsmodels surface the reference
+exercises (SURVEY.md §2.2 X10): SARIMAX state-space ML fit, Holt-Winters
+exponential smoothing, ARMA sample generation, plus the vmappable
+Nelder-Mead optimizer that statsmodels' ``fit(method='nm')`` maps to.
+
+Everything here is pure JAX (``lax.scan`` / ``lax.while_loop``), built to
+``vmap`` across thousands of SKU groups at once — one sharded batched fit
+replaces the reference's one-Spark-task-per-group Python processes
+(``group_apply/02_Fine_Grained_Demand_Forecasting.py:516-528``).
+"""
+
+from .arma import arma_generate_sample, lfilter
+from .holt_winters import HoltWintersResult, holt_winters_fit, holt_winters_forecast
+from .kalman import kalman_filter, kalman_forecast
+from .neldermead import NelderMeadResult, nelder_mead
+from .sarimax import (
+    SarimaxConfig,
+    SarimaxResult,
+    sarimax_fit,
+    sarimax_loglike,
+    sarimax_predict,
+)
+
+__all__ = [
+    "arma_generate_sample",
+    "lfilter",
+    "HoltWintersResult",
+    "holt_winters_fit",
+    "holt_winters_forecast",
+    "kalman_filter",
+    "kalman_forecast",
+    "NelderMeadResult",
+    "nelder_mead",
+    "SarimaxConfig",
+    "SarimaxResult",
+    "sarimax_fit",
+    "sarimax_loglike",
+    "sarimax_predict",
+]

@@ -1,0 +1,66 @@
+"""ARMA sample generation as a ``lax.scan`` IIR filter.
+
+Replaces ``statsmodels.tsa.arma_generate_sample`` as used by the demand
+generator (``group_apply/_resources/01-data-generator.py:246-254``): the
+reference draws one ARMA series per SKU in a pandas UDF; here a single
+``vmap`` over per-SKU keys/params draws every series at once on device.
+
+Conventions match statsmodels/scipy: ``ar`` and ``ma`` are full lag
+polynomials including the leading 1, with AR signs as in
+``ar = [1, -phi_1, ..., -phi_p]``. The filter itself is scipy's
+``lfilter`` (transposed direct-form II) as a scan, so outputs match
+``scipy.signal.lfilter(ma, ar, eps)`` exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def lfilter(b: jax.Array, a: jax.Array, x: jax.Array) -> jax.Array:
+    """IIR filter ``y = lfilter(b, a, x)``, matching scipy semantics.
+
+    ``b``/``a`` are the numerator/denominator polynomials; ``a[0]`` must be
+    nonzero (it normalizes both). Implemented as transposed direct-form II:
+
+        y[t] = b[0] x[t] + z[0]
+        z[i] = b[i+1] x[t] + z[i+1] - a[i+1] y[t]
+    """
+    b = jnp.atleast_1d(jnp.asarray(b))
+    a = jnp.atleast_1d(jnp.asarray(a))
+    # nfilt >= 2 keeps the scan state non-empty even for the ARMA(0,0) /
+    # pure-gain case (b and a both scalar), where the filter is y = (b0/a0) x.
+    nfilt = max(b.shape[0], a.shape[0], 2)
+    b = jnp.pad(b, (0, nfilt - b.shape[0])) / a[0]
+    a = jnp.pad(a, (0, nfilt - a.shape[0])) / a[0]
+
+    def step(z, x_t):
+        y_t = b[0] * x_t + z[0]
+        z_new = b[1:] * x_t + jnp.concatenate([z[1:], jnp.zeros(1, z.dtype)]) - a[1:] * y_t
+        return z_new, y_t
+
+    z0 = jnp.zeros(nfilt - 1, x.dtype)
+    _, y = lax.scan(step, z0, x)
+    return y
+
+
+def arma_generate_sample(
+    key: jax.Array,
+    ar: jax.Array,
+    ma: jax.Array,
+    nsample: int,
+    scale: float | jax.Array = 1.0,
+    burnin: int = 0,
+) -> jax.Array:
+    """Draw an ARMA sample; mirrors ``sm.tsa.arma_generate_sample``.
+
+    The reference calls this with ``burnin=3000`` per SKU
+    (``01-data-generator.py:246``). ``vmap`` over ``key`` (and optionally
+    per-series ``ar``/``ma`` rows padded to equal length) to draw a whole
+    SKU panel in one call.
+    """
+    eps = scale * jax.random.normal(key, (nsample + burnin,))
+    y = lfilter(ma, ar, eps)
+    return y[burnin:]

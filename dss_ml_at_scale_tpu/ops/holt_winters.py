@@ -1,0 +1,210 @@
+"""Holt-Winters exponential smoothing via ``lax.scan``.
+
+Covers the four variants the reference's EDA fits
+(``group_apply/02_Fine_Grained_Demand_Forecasting.py:143-188``):
+additive trend × {additive, multiplicative} seasonal, each optionally
+damped, with optional Box-Cox pre-transform, least-squares (SSE)
+parameter estimation — statsmodels ``ExponentialSmoothing(...,
+use_boxcox=True).fit(method='ls')`` capability, re-built as a pure JAX
+function that ``vmap``s across series.
+
+Deviations from statsmodels (documented, not accidental):
+- initial level/trend/seasonals use the standard two-season heuristic
+  rather than joining the optimization (``initialization_method=
+  "estimated"``); smoothing params are still SSE-optimized.
+- Box-Cox lambda is estimated by golden-section MLE on the concentrated
+  likelihood (scipy ``boxcox`` does the same via Brent); inputs are
+  clamped to a small positive floor first (statsmodels raises on
+  non-positive data — a traced value can't, so the clamp is the
+  documented behavior for zero-demand periods).
+
+Variant flags (``seasonal``/``damped``/``use_boxcox``) are Python-static
+at fit time, like the statsmodels constructor, and are recorded in the
+result (as array codes) so :func:`holt_winters_forecast` can never be
+called with a mismatched variant.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .neldermead import nelder_mead
+
+_SEASONAL_CODES = {None: 0, "add": 1, "mul": 2}
+
+
+class HoltWintersResult(NamedTuple):
+    alpha: jax.Array
+    beta: jax.Array
+    gamma: jax.Array
+    phi: jax.Array  # damping (1.0 when undamped)
+    boxcox_lambda: jax.Array  # 1.0 when no transform
+    use_boxcox: jax.Array  # bool: whether fit ran on the Box-Cox scale
+    seasonal_code: jax.Array  # 0 = none, 1 = additive, 2 = multiplicative
+    level: jax.Array  # final level state
+    trend: jax.Array  # final trend state
+    season: jax.Array  # (m,) final seasonal buffer; [h % m] applies to step h+1
+    fittedvalues: jax.Array  # (n,) one-step-ahead fitted values, original scale
+    sse: jax.Array  # SSE on the (transformed) scale the fit ran on
+
+
+def _boxcox(y, lam):
+    return jnp.where(jnp.abs(lam) < 1e-8, jnp.log(y), (y**lam - 1.0) / lam)
+
+
+def _inv_boxcox(z, lam):
+    return jnp.where(
+        jnp.abs(lam) < 1e-8, jnp.exp(z), jnp.maximum(lam * z + 1.0, 1e-12) ** (1.0 / lam)
+    )
+
+
+def boxcox_mle_lambda(y: jax.Array, lo: float = -1.0, hi: float = 2.0) -> jax.Array:
+    """Golden-section maximizer of the concentrated Box-Cox likelihood.
+
+    ``y`` must be positive (callers clamp).
+    """
+    n = y.shape[0]
+    logsum = jnp.log(y).sum()
+
+    def neg_llf(lam):
+        z = _boxcox(y, lam)
+        return 0.5 * n * jnp.log(jnp.maximum(z.var(), 1e-300)) - (lam - 1.0) * logsum
+
+    gr = 0.6180339887498949
+
+    def body(_, ab):
+        a, b = ab
+        c = b - gr * (b - a)
+        d = a + gr * (b - a)
+        shrink_right = neg_llf(c) < neg_llf(d)
+        return jnp.where(shrink_right, a, c), jnp.where(shrink_right, d, b)
+
+    a, b = lax.fori_loop(0, 60, body, (jnp.asarray(lo, y.dtype), jnp.asarray(hi, y.dtype)))
+    return 0.5 * (a + b)
+
+
+def _heuristic_init(z, m, seasonal):
+    """Level/trend/seasonals from the first two complete seasons."""
+    s1 = lax.dynamic_slice(z, (0,), (m,))
+    s2 = lax.dynamic_slice(z, (m,), (m,))
+    l0 = s1.mean()
+    b0 = (s2.mean() - s1.mean()) / m
+    if seasonal == "mul":
+        s0 = s1 / jnp.maximum(l0, 1e-12)
+    else:
+        s0 = s1 - l0
+    return l0, b0, s0
+
+
+def _smooth(z, params, init, m, seasonal, damped):
+    """Run the recursions; returns (sse, fitted, level, trend, season)."""
+    alpha, beta, gamma, phi = params
+    l0, b0, s0 = init
+
+    def step(carry, z_t):
+        l, b, s = carry
+        s_old = s[0]
+        lb = l + phi * b
+        if seasonal == "mul":
+            fitted = lb * s_old
+            l_new = alpha * (z_t / jnp.where(s_old == 0, 1e-12, s_old)) + (1 - alpha) * lb
+            s_new = gamma * (z_t / jnp.maximum(lb, 1e-12)) + (1 - gamma) * s_old
+        elif seasonal == "add":
+            fitted = lb + s_old
+            l_new = alpha * (z_t - s_old) + (1 - alpha) * lb
+            s_new = gamma * (z_t - lb) + (1 - gamma) * s_old
+        else:
+            fitted = lb
+            l_new = alpha * z_t + (1 - alpha) * lb
+            s_new = s_old
+        b_new = beta * (l_new - l) + (1 - beta) * phi * b
+        s_buf = jnp.concatenate([s[1:], s_new[None]])
+        return (l_new, b_new, s_buf), fitted
+
+    (l, b, s), fitted = lax.scan(step, (l0, b0, s0), z)
+    sse = jnp.sum((z - fitted) ** 2)
+    return sse, fitted, l, b, s
+
+
+@partial(jax.jit, static_argnames=("seasonal_periods", "seasonal", "damped", "use_boxcox", "max_iter"))
+def holt_winters_fit(
+    y: jax.Array,
+    seasonal_periods: int,
+    seasonal: str | None = "add",
+    damped: bool = False,
+    use_boxcox: bool = False,
+    max_iter: int = 200,
+) -> HoltWintersResult:
+    """Fit additive-trend Holt-Winters to ``y`` by SSE minimization."""
+    y = jnp.asarray(y)
+    m = seasonal_periods
+    if y.shape[0] < 2 * m:
+        raise ValueError(
+            f"need >= 2 full seasons ({2 * m} points) to initialize, got {y.shape[0]}"
+        )
+    if use_boxcox or seasonal == "mul":
+        y = jnp.maximum(y, 1e-6)  # Box-Cox / mul-seasonal need positive data
+    lam = boxcox_mle_lambda(y) if use_boxcox else jnp.asarray(1.0, y.dtype)
+    z = _boxcox(y, lam) if use_boxcox else y
+    init = _heuristic_init(z, m, seasonal)
+
+    def unpack(theta):
+        alpha = jax.nn.sigmoid(theta[0])
+        beta = jax.nn.sigmoid(theta[1]) * alpha  # 0 < beta < alpha
+        gamma = jax.nn.sigmoid(theta[2]) * (1 - alpha)  # 0 < gamma < 1 - alpha
+        phi = 0.8 + 0.198 * jax.nn.sigmoid(theta[3]) if damped else jnp.asarray(1.0, theta.dtype)
+        return alpha, beta, gamma, phi
+
+    def objective(theta):
+        sse, *_ = _smooth(z, unpack(theta), init, m, seasonal, damped)
+        return sse
+
+    theta0 = jnp.array([0.0, -1.0, -1.0, 0.0], z.dtype)
+    res = nelder_mead(objective, theta0, max_iter=max_iter, xatol=1e-5, fatol=1e-6)
+    alpha, beta, gamma, phi = unpack(res.x)
+    sse, fitted, l, b, s = _smooth(z, (alpha, beta, gamma, phi), init, m, seasonal, damped)
+    fitted_orig = _inv_boxcox(fitted, lam) if use_boxcox else fitted
+    return HoltWintersResult(
+        alpha,
+        beta,
+        gamma,
+        phi,
+        lam,
+        jnp.asarray(use_boxcox),
+        jnp.asarray(_SEASONAL_CODES[seasonal], jnp.int32),
+        l,
+        b,
+        s,
+        fitted_orig,
+        sse,
+    )
+
+
+def holt_winters_forecast(result: HoltWintersResult, horizon: int) -> jax.Array:
+    """Forecast ``horizon`` steps ahead (original scale).
+
+    The variant (seasonal mode, Box-Cox) is read from the result, so the
+    forecast always matches the scale and structure the fit used.
+    """
+    h = jnp.arange(1, horizon + 1)
+    phi = result.phi
+    # Damped trend accumulates sum_{j=1..h} phi^j; phi=1 degenerates to h.
+    bsum = jnp.where(
+        jnp.abs(phi - 1.0) < 1e-8,
+        h.astype(result.level.dtype),
+        phi * (1 - phi**h) / (1 - phi + 1e-12),
+    )
+    m = result.season.shape[0]
+    s = result.season[(h - 1) % m]
+    base = result.level + bsum * result.trend
+    z = jnp.where(
+        result.seasonal_code == 2,
+        base * s,
+        jnp.where(result.seasonal_code == 1, base + s, base),
+    )
+    return jnp.where(result.use_boxcox, _inv_boxcox(z, result.boxcox_lambda), z)

@@ -1,0 +1,265 @@
+"""SARIMAX (ARIMA + exogenous regressors) as a vmappable JAX program.
+
+Capability target (SURVEY.md §2.2 X10): statsmodels
+``SARIMAX(train, exog=..., order=(p,d,q), seasonal_order=(0,0,0,0))
+.fit(method='nm')`` then ``.predict(start, end, exog=...)`` — the exact
+surface the reference's per-SKU tuner exercises
+(``group_apply/02_Fine_Grained_Demand_Forecasting.py:441-494``), with
+p ∈ [0,4], d ∈ [0,2], q ∈ [0,4] searched by Hyperopt (``:462-464``).
+
+TPU-first design: the reference runs one Python/statsmodels fit per Spark
+task per SKU. Here orders ``(p, d, q)`` are **traced** values masked
+against static maxima (``SarimaxConfig``), so a single compiled program
+``vmap``s the whole fit across thousands of groups — and across HPO
+candidates — at once. That is the max-order padded parameterization
+SURVEY.md §7 ("hard parts" #1) calls for.
+
+Model: y_t = x_t'beta + u_t, with Delta^d u_t ~ ARMA(p, q). The ARMA part
+runs through a Harvey-representation Kalman filter (state dim
+``max(max_p, max_q + 1)``); initialization solves the stationary
+Lyapunov equation when valid and falls back to approximate-diffuse
+(statsmodels' ``initialization='approximate_diffuse'``) otherwise, which
+covers non-stationary iterates since stationarity is not enforced
+(reference passes ``enforce_stationarity=False``, ``:447-448``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .kalman import kalman_filter
+from .neldermead import nelder_mead
+
+
+@dataclasses.dataclass(frozen=True)
+class SarimaxConfig:
+    """Static shape bounds; traced per-fit orders are masked against these."""
+
+    max_p: int = 4
+    max_d: int = 2
+    max_q: int = 4
+    k_exog: int = 0
+    kappa: float = 1e4  # approximate-diffuse prior variance scale
+    max_iter: int = 200  # Nelder-Mead iterations (reference: method='nm')
+
+    @property
+    def state_dim(self) -> int:
+        return max(self.max_p, self.max_q + 1)
+
+    @property
+    def n_params(self) -> int:
+        # [beta (k_exog), phi (max_p), theta (max_q), log_sigma2]
+        return self.k_exog + self.max_p + self.max_q + 1
+
+    def unpack(self, params):
+        k, p, q = self.k_exog, self.max_p, self.max_q
+        return (
+            params[:k],
+            params[k : k + p],
+            params[k + p : k + p + q],
+            params[k + p + q],
+        )
+
+
+class SarimaxResult(NamedTuple):
+    params: jax.Array  # (n_params,) packed [beta, phi, theta, log_sigma2]
+    loglike: jax.Array
+    n_iter: jax.Array
+    converged: jax.Array
+
+
+def _difference(x: jax.Array, d: jax.Array, max_d: int) -> jax.Array:
+    """Delta^d x with traced d <= max_d; first d outputs are invalid."""
+    z = jnp.zeros_like(x[:1])
+    branches = [lambda x=x: x]
+    if max_d >= 1:
+        branches.append(lambda x=x: jnp.concatenate([z, x[1:] - x[:-1]]))
+    if max_d >= 2:
+        branches.append(
+            lambda x=x: jnp.concatenate([z, z, x[2:] - 2 * x[1:-1] + x[:-2]])
+        )
+    return lax.switch(jnp.clip(d, 0, max_d), branches)
+
+
+def _ssm_matrices(cfg: SarimaxConfig, phi_eff, theta_eff, sigma2):
+    """Harvey representation: T companion on phi, R = [1, theta...]."""
+    r = cfg.state_dim
+    T = jnp.zeros((r, r), phi_eff.dtype)
+    T = T.at[:, 0].set(jnp.pad(phi_eff, (0, r - cfg.max_p)))
+    T = T.at[jnp.arange(r - 1), jnp.arange(1, r)].set(1.0)
+    R = jnp.concatenate([jnp.ones(1, theta_eff.dtype), jnp.pad(theta_eff, (0, r - 1 - cfg.max_q))])
+    R = R.reshape(r, 1)
+    Q = sigma2.reshape(1, 1)
+    Z = jnp.zeros(r, phi_eff.dtype).at[0].set(1.0)
+    return T, R, Q, Z
+
+
+def _init_cov(cfg: SarimaxConfig, T, RQR, sigma2):
+    """Stationary Lyapunov solve, approximate-diffuse fallback."""
+    r = cfg.state_dim
+    eye = jnp.eye(r * r, dtype=T.dtype)
+    P_vec = jnp.linalg.solve(eye - jnp.kron(T, T), RQR.reshape(-1))
+    P = P_vec.reshape(r, r)
+    P = 0.5 * (P + P.T)
+    kappa = cfg.kappa * jnp.maximum(sigma2, 1.0)
+    # Padded state dims legitimately have zero stationary variance, so the
+    # validity check allows diag == 0; only reject non-finite / negative /
+    # exploding solves (non-stationary phi iterates under Nelder-Mead).
+    ok = (
+        jnp.all(jnp.isfinite(P))
+        & jnp.all(jnp.diag(P) >= -1e-6)
+        & (jnp.max(jnp.abs(P)) < kappa)
+    )
+    return jnp.where(ok, P, kappa * jnp.eye(r, dtype=T.dtype))
+
+
+def _filter(cfg: SarimaxConfig, params, y, exog, order, n_valid):
+    """Shared setup: regression residual → difference → Kalman filter."""
+    p, d, q = order
+    beta, phi, theta, log_sigma2 = cfg.unpack(params)
+    phi_eff = phi * (jnp.arange(cfg.max_p) < p)
+    theta_eff = theta * (jnp.arange(cfg.max_q) < q)
+    sigma2 = jnp.exp(log_sigma2)
+
+    resid = y - (exog @ beta if cfg.k_exog else jnp.zeros_like(y))
+    w = _difference(resid, d, cfg.max_d)
+    t_idx = jnp.arange(y.shape[0])
+    mask = (t_idx >= d) & (t_idx < n_valid)
+
+    T, R, Q, Z = _ssm_matrices(cfg, phi_eff, theta_eff, sigma2)
+    P0 = _init_cov(cfg, T, R @ Q @ R.T, sigma2)
+    a0 = jnp.zeros(cfg.state_dim, y.dtype)
+    filt = kalman_filter(w, T, R, Q, Z, jnp.asarray(0.0, y.dtype), a0, P0, mask=mask)
+    return filt, resid, mask
+
+
+def sarimax_loglike(cfg: SarimaxConfig, params, y, exog, order, n_valid) -> jax.Array:
+    """Exact (prediction-error decomposition) log-likelihood."""
+    filt, _, _ = _filter(cfg, params, y, exog, order, n_valid)
+    return filt.loglike
+
+
+def _start_params(cfg: SarimaxConfig, y, exog, order, n_valid):
+    d = order[1]
+    t_idx = jnp.arange(y.shape[0])
+    obs = (t_idx < n_valid).astype(y.dtype)
+    if cfg.k_exog:
+        # Masked ridge OLS of y on exog for beta start values.
+        Xw = exog * obs[:, None]
+        beta0 = jnp.linalg.solve(
+            Xw.T @ exog + 1e-3 * jnp.eye(cfg.k_exog, dtype=y.dtype), Xw.T @ y
+        )
+        resid = y - exog @ beta0
+    else:
+        beta0 = jnp.zeros(0, y.dtype)
+        resid = y
+    w = _difference(resid, d, cfg.max_d)
+    wmask = (t_idx >= d) & (t_idx < n_valid)
+    denom = jnp.maximum(wmask.sum(), 1)
+    wm = jnp.where(wmask, w, 0.0)
+    var = jnp.maximum(jnp.sum(wm * wm) / denom - (jnp.sum(wm) / denom) ** 2, 1e-8)
+    return jnp.concatenate(
+        [
+            beta0,
+            jnp.zeros(cfg.max_p + cfg.max_q, y.dtype),
+            jnp.log(var)[None],
+        ]
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def sarimax_fit(
+    cfg: SarimaxConfig,
+    y: jax.Array,
+    exog: jax.Array,
+    order: jax.Array,
+    n_valid: jax.Array | int | None = None,
+) -> SarimaxResult:
+    """ML fit via Nelder-Mead (the reference's ``method='nm'``).
+
+    ``order`` is a length-3 int array ``(p, d, q)`` — traced, so the same
+    compiled fit serves every order in the HPO grid. ``vmap`` over
+    ``(y, exog, order, n_valid)`` for batched per-group fits.
+    """
+    y = jnp.asarray(y)
+    n_valid = jnp.asarray(y.shape[0] if n_valid is None else n_valid)
+    order = jnp.asarray(order)
+    x0 = _start_params(cfg, y, exog, order, n_valid)
+    n_eff = jnp.maximum(n_valid - order[1], 1).astype(y.dtype)
+
+    # Coefficients masked out by (p, q) don't touch the likelihood; pin them
+    # with a quadratic penalty so the simplex doesn't wander flat directions.
+    pin = jnp.concatenate(
+        [
+            jnp.zeros(cfg.k_exog, y.dtype),
+            (jnp.arange(cfg.max_p) >= order[0]).astype(y.dtype),
+            (jnp.arange(cfg.max_q) >= order[2]).astype(y.dtype),
+            jnp.zeros(1, y.dtype),
+        ]
+    )
+
+    def objective(params):
+        nll = -sarimax_loglike(cfg, params, y, exog, order, n_valid) / n_eff
+        return nll + 10.0 * jnp.sum((params * pin) ** 2)
+
+    # Two NM rounds: a restart re-inflates the simplex around the incumbent,
+    # which recovers the progress a 9+-dim padded simplex loses to premature
+    # shrinkage (statsmodels' unpadded 'nm' fit has only p+q+1 dims).
+    res = nelder_mead(objective, x0, max_iter=cfg.max_iter, xatol=1e-5, fatol=1e-7)
+    res2 = nelder_mead(objective, res.x, max_iter=cfg.max_iter, xatol=1e-5, fatol=1e-7)
+    take2 = res2.fun <= res.fun
+    best_x = jnp.where(take2, res2.x, res.x)
+    best_fun = jnp.where(take2, res2.fun, res.fun)
+    nll_best = best_fun - 10.0 * jnp.sum((best_x * pin) ** 2)
+    best_conv = jnp.where(take2, res2.converged, res.converged)
+    return SarimaxResult(best_x, -nll_best * n_eff, res.n_iter + res2.n_iter, best_conv)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def sarimax_predict(
+    cfg: SarimaxConfig,
+    params: jax.Array,
+    y: jax.Array,
+    exog: jax.Array,
+    order: jax.Array,
+    n_valid: jax.Array | int,
+) -> jax.Array:
+    """Full-range prediction, the reference's ``predict(start, end, exog)``.
+
+    Arrays span the full range (train + horizon): ``y`` is observed up to
+    ``n_valid`` (ignored after), ``exog`` holds known future regressors.
+    Returns length-N predictions: one-step-ahead in-sample for
+    ``t < n_valid`` (first ``d`` points echo the observation, as there is
+    nothing to difference against), dynamic multi-step forecasts after —
+    matching statsmodels' behavior when predicting past the sample end.
+    """
+    y = jnp.asarray(y)
+    n_valid = jnp.asarray(n_valid)
+    order = jnp.asarray(order)
+    p, d, q = order
+    beta = cfg.unpack(params)[0]
+    xb = exog @ beta if cfg.k_exog else jnp.zeros_like(y)
+
+    filt, resid, _ = _filter(cfg, params, y, exog, order, n_valid)
+    w_hat = filt.pred_mean  # one-step in-sample; multi-step beyond n_valid
+    t_idx = jnp.arange(y.shape[0])
+
+    def undiff_step(carry, inp):
+        rm1, rm2 = carry
+        w_hat_t, r_obs_t, t = inp
+        lag_term = jnp.where(
+            d == 1, rm1, jnp.where(d == 2, 2 * rm1 - rm2, jnp.zeros_like(rm1))
+        )
+        pred = jnp.where(t < d, r_obs_t, w_hat_t + lag_term)
+        r_t = jnp.where(t < n_valid, r_obs_t, pred)
+        return (r_t, rm1), pred
+
+    zero = jnp.zeros((), y.dtype)
+    _, r_pred = lax.scan(undiff_step, (zero, zero), (w_hat, resid, t_idx))
+    return xb + r_pred

@@ -294,3 +294,20 @@ def test_unpersist_semantics():
     assert b.value == [1, 2]
     b.unpersist()
     assert b.value == [1, 2]  # rebuilt
+
+
+def test_fmin_nonfinite_loss_is_isolated():
+    # A diverged trial (NaN loss) must fail that trial, not win argmin.
+    from itertools import count
+
+    calls = count()
+
+    def obj(p):
+        return float("nan") if next(calls) == 0 else (p["x"] - 2.0) ** 2
+
+    from dss_ml_at_scale_tpu.hpo import Trials, fmin, hp
+
+    trials = Trials()
+    best = fmin(obj, {"x": hp.uniform("x", 0, 5)}, max_evals=15, trials=trials, rstate=0)
+    assert abs(best["x"] - 2.0) < 1.5
+    assert sum(r["status"] == "fail" for r in trials.results) == 1

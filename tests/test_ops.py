@@ -1,0 +1,309 @@
+"""Unit tests for the JAX time-series kernels (ops).
+
+Validation strategy per SURVEY.md §4: Kalman/SARIMAX against closed-form
+and hand-rolled NumPy filters, the linear filter against scipy, the
+optimizer against scipy.optimize — statsmodels itself is not in the
+image, so parity is checked against the underlying math.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+import scipy.signal
+
+from dss_ml_at_scale_tpu.ops import (
+    SarimaxConfig,
+    arma_generate_sample,
+    holt_winters_fit,
+    holt_winters_forecast,
+    kalman_filter,
+    lfilter,
+    nelder_mead,
+    sarimax_fit,
+    sarimax_loglike,
+    sarimax_predict,
+)
+
+
+# -- lfilter / ARMA -----------------------------------------------------------
+
+
+def test_lfilter_matches_scipy(rng):
+    b = [1.0, 0.5, 0.2]
+    a = [1.0, -0.6, 0.1]
+    x = rng.normal(size=300).astype(np.float32)
+    ours = np.asarray(lfilter(jnp.array(b), jnp.array(a), jnp.array(x)))
+    ref = scipy.signal.lfilter(b, a, x)
+    np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+
+def test_arma_sample_statistics():
+    # AR(1) with phi=0.7: lag-1 autocorrelation ~ 0.7 after burn-in.
+    s = np.asarray(
+        arma_generate_sample(
+            jax.random.key(0), jnp.array([1.0, -0.7]), jnp.array([1.0]), 4000, burnin=500
+        )
+    )
+    assert s.shape == (4000,)
+    ac = np.corrcoef(s[:-1], s[1:])[0, 1]
+    assert abs(ac - 0.7) < 0.05
+
+
+def test_lfilter_scalar_polynomials():
+    # ARMA(0,0): pure white noise through unit polynomials.
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    out = np.asarray(lfilter(jnp.array([2.0]), jnp.array([1.0]), jnp.array(x)))
+    np.testing.assert_allclose(out, 2.0 * x, atol=1e-6)
+    s = arma_generate_sample(jax.random.key(0), jnp.array([1.0]), jnp.array([1.0]), 50)
+    assert s.shape == (50,)
+
+
+def test_arma_sample_vmap_per_sku_keys():
+    # The demand generator draws one series per SKU from per-SKU keys
+    # (reference: 01-data-generator.py:242-254) — here a single vmap.
+    keys = jax.random.split(jax.random.key(1), 5)
+    draw = jax.vmap(
+        lambda k: arma_generate_sample(
+            k, jnp.array([1.0, -0.5]), jnp.array([1.0, 0.3]), 100, burnin=50
+        )
+    )
+    panel = np.asarray(draw(keys))
+    assert panel.shape == (5, 100)
+    assert len({tuple(np.round(row, 5)) for row in panel}) == 5  # distinct series
+
+
+# -- Nelder-Mead --------------------------------------------------------------
+
+
+def test_nelder_mead_rosenbrock_matches_scipy():
+    def rosen(v):
+        return 100.0 * (v[1] - v[0] ** 2) ** 2 + (1.0 - v[0]) ** 2
+
+    res = nelder_mead(rosen, jnp.array([-1.2, 1.0]), max_iter=500, xatol=1e-6, fatol=1e-9)
+    ref = scipy.optimize.minimize(
+        lambda v: rosen(jnp.array(v)), [-1.2, 1.0], method="Nelder-Mead"
+    )
+    np.testing.assert_allclose(np.asarray(res.x), [1.0, 1.0], atol=1e-3)
+    assert float(res.fun) <= ref.fun + 1e-6
+
+
+def test_nelder_mead_vmap_batch():
+    centers = jnp.array([[1.0, -2.0], [3.0, 0.5], [-1.0, 4.0]])
+
+    def make_obj(c):
+        return lambda v: jnp.sum((v - c) ** 2)
+
+    res = jax.vmap(lambda c: nelder_mead(make_obj(c), jnp.zeros(2), max_iter=300))(centers)
+    np.testing.assert_allclose(np.asarray(res.x), np.asarray(centers), atol=1e-3)
+
+
+def test_nelder_mead_handles_nan_objective():
+    # Non-finite regions must not poison the simplex (likelihoods do this).
+    def fn(v):
+        val = jnp.sum(v**2)
+        return jnp.where(v[0] < -0.5, jnp.nan, val)
+
+    res = nelder_mead(fn, jnp.array([1.0, 1.0]), max_iter=300)
+    np.testing.assert_allclose(np.asarray(res.x), [0.0, 0.0], atol=1e-3)
+
+
+# -- Kalman -------------------------------------------------------------------
+
+
+def _ar1_exact_loglike(y, phi, s2):
+    ll = -0.5 * math.log(2 * math.pi * s2 / (1 - phi**2)) - y[0] ** 2 / (
+        2 * s2 / (1 - phi**2)
+    )
+    e = y[1:] - phi * y[:-1]
+    ll += np.sum(-0.5 * np.log(2 * math.pi * s2) - e**2 / (2 * s2))
+    return ll
+
+
+def _ar1_series(rng, n, phi=0.7):
+    y = np.zeros(n)
+    for t in range(1, n):
+        y[t] = phi * y[t - 1] + rng.normal()
+    return y.astype(np.float32)
+
+
+def test_kalman_ar1_closed_form(rng):
+    phi, s2 = 0.7, 1.0
+    y = _ar1_series(rng, 200, phi)
+    T = jnp.array([[phi]])
+    R = jnp.array([[1.0]])
+    Q = jnp.array([[s2]])
+    Z = jnp.array([1.0])
+    P0 = jnp.array([[s2 / (1 - phi**2)]])
+    filt = kalman_filter(jnp.array(y), T, R, Q, Z, 0.0, jnp.zeros(1), P0)
+    assert abs(float(filt.loglike) - _ar1_exact_loglike(y, phi, s2)) < 1e-2
+
+
+def test_kalman_mask_equals_truncation(rng):
+    phi = 0.5
+    y = _ar1_series(rng, 150, phi)
+    T, R, Q, Z = jnp.array([[phi]]), jnp.array([[1.0]]), jnp.array([[1.0]]), jnp.array([1.0])
+    P0 = jnp.array([[1.0 / (1 - phi**2)]])
+    full = kalman_filter(jnp.array(y[:100]), T, R, Q, Z, 0.0, jnp.zeros(1), P0)
+    padded = kalman_filter(
+        jnp.array(y), T, R, Q, Z, 0.0, jnp.zeros(1), P0, mask=jnp.arange(150) < 100
+    )
+    assert abs(float(full.loglike) - float(padded.loglike)) < 1e-3
+
+
+# -- Holt-Winters -------------------------------------------------------------
+
+
+def _seasonal_series(rng, n=120, m=4):
+    t = np.arange(n)
+    return (50 + 0.5 * t + 8 * np.sin(2 * np.pi * t / m) + rng.normal(0, 1, n)).astype(
+        np.float32
+    )
+
+
+def test_holt_winters_additive_fit_and_forecast(rng):
+    m = 4
+    y = _seasonal_series(rng, 120, m)
+    res = holt_winters_fit(jnp.array(y), m, seasonal="add")
+    assert 0 < float(res.alpha) < 1 and 0 < float(res.gamma) < 1
+    fc = np.asarray(holt_winters_forecast(res, 8))
+    t = 120 + np.arange(8)
+    true = 50 + 0.5 * t + 8 * np.sin(2 * np.pi * t / m)
+    assert np.abs(fc - true).max() < 3.0  # within 3 sigma of the noise
+
+
+def test_holt_winters_damped_mul_boxcox(rng):
+    # The reference's fit4 variant: damped additive trend, multiplicative
+    # seasonal, Box-Cox (group_apply/02...py:177-185).
+    t = np.arange(120)
+    y = np.maximum(
+        np.exp(0.01 * t) * (10 + 3 * np.sin(2 * np.pi * t / 4)) + rng.normal(0, 0.2, 120),
+        0.1,
+    ).astype(np.float32)
+    res = holt_winters_fit(jnp.array(y), 4, seasonal="mul", damped=True, use_boxcox=True)
+    assert 0.8 <= float(res.phi) <= 0.998
+    assert abs(float(res.boxcox_lambda)) < 0.5  # exponential data wants lambda ~ 0
+    assert np.isfinite(np.asarray(res.fittedvalues)).all()
+    fc = np.asarray(holt_winters_forecast(res, 6))
+    assert np.isfinite(fc).all() and (fc > 0).all()
+
+
+def test_holt_winters_short_series_raises():
+    with pytest.raises(ValueError, match="2 full seasons"):
+        holt_winters_fit(jnp.ones(18), 12)
+
+
+def test_holt_winters_boxcox_tolerates_zero_demand(rng):
+    # Intermittent demand: zero periods must not produce non-finite fits
+    # (inputs are clamped to a positive floor, documented deviation).
+    y = np.maximum(_seasonal_series(rng, 80), 0)
+    y[10] = 0.0
+    res = holt_winters_fit(jnp.array(y.astype(np.float32)), 4, use_boxcox=True)
+    assert np.isfinite(float(res.sse))
+    assert np.isfinite(np.asarray(holt_winters_forecast(res, 4))).all()
+
+
+def test_holt_winters_vmap(rng):
+    ys = jnp.stack([jnp.array(_seasonal_series(rng, 80)) for _ in range(3)])
+    res = jax.vmap(lambda y: holt_winters_fit(y, 4, seasonal="add"))(ys)
+    assert res.fittedvalues.shape == (3, 80)
+    assert np.isfinite(np.asarray(res.sse)).all()
+
+
+# -- SARIMAX ------------------------------------------------------------------
+
+CFG0 = SarimaxConfig(k_exog=0)
+
+
+def test_sarimax_loglike_matches_closed_form(rng):
+    y = _ar1_series(rng, 300)
+    params = np.zeros(CFG0.n_params, np.float32)
+    params[0] = 0.7  # phi_1
+    ll = float(
+        sarimax_loglike(
+            CFG0, jnp.array(params), jnp.array(y), jnp.zeros((300, 0)), jnp.array([1, 0, 0]), 300
+        )
+    )
+    assert abs(ll - _ar1_exact_loglike(y, 0.7, 1.0)) < 0.01
+
+
+def test_sarimax_ar1_fit_recovery(rng):
+    y = _ar1_series(rng, 300)
+    res = sarimax_fit(CFG0, jnp.array(y), jnp.zeros((300, 0)), jnp.array([1, 0, 0]))
+    _, phi, _, log_s2 = CFG0.unpack(res.params)
+    assert abs(float(phi[0]) - 0.7) < 0.1
+    assert np.abs(np.asarray(phi[1:])).max() < 0.05  # masked lags pinned
+    assert abs(float(jnp.exp(log_s2)) - 1.0) < 0.2
+    # The optimizer must reach at least the likelihood of the true params.
+    true = np.zeros(CFG0.n_params, np.float32)
+    true[0] = 0.7
+    ll_true = float(
+        sarimax_loglike(CFG0, jnp.array(true), jnp.array(y), jnp.zeros((300, 0)), jnp.array([1, 0, 0]), 300)
+    )
+    assert float(res.loglike) >= ll_true - 0.5
+
+
+def test_sarimax_exog_and_difference(rng):
+    # y = 5x + random walk: order (0,1,0) with one exog regressor.
+    n = 300
+    x = rng.normal(size=(n, 1)).astype(np.float32)
+    u = np.cumsum(rng.normal(size=n)).astype(np.float32)
+    y = 5.0 * x[:, 0] + u
+    cfg = SarimaxConfig(k_exog=1)
+    res = sarimax_fit(cfg, jnp.array(y), jnp.array(x), jnp.array([0, 1, 0]))
+    beta, _, _, _ = cfg.unpack(res.params)
+    assert abs(float(beta[0]) - 5.0) < 0.3
+
+
+def test_sarimax_predict_full_range(rng):
+    # Train region one-step predictions + dynamic forecast past n_valid,
+    # mirroring predict(start=min(train), end=max(score), exog=score_exo).
+    n, n_train = 300, 260
+    x = rng.normal(size=(n, 1)).astype(np.float32)
+    u = np.cumsum(rng.normal(size=n)).astype(np.float32)
+    y = 5.0 * x[:, 0] + u
+    cfg = SarimaxConfig(k_exog=1)
+    res = sarimax_fit(cfg, jnp.array(y), jnp.array(x), jnp.array([0, 1, 0]), n_train)
+    pred = np.asarray(
+        sarimax_predict(cfg, res.params, jnp.array(y), jnp.array(x), jnp.array([0, 1, 0]), n_train)
+    )
+    assert pred.shape == (n,)
+    # In-sample one-step error ~ innovation scale.
+    in_err = np.abs(pred[2:n_train] - y[2:n_train])
+    assert np.median(in_err) < 2.0
+    # Forecast: exog effect tracked, random walk held at last level.
+    fc_err = np.abs(pred[n_train:] - (5.0 * x[n_train:, 0] + u[n_train - 1]))
+    assert fc_err.max() < 1.0
+
+
+def test_sarimax_vmap_different_orders_matches_single(rng):
+    n = 200
+    y1 = _ar1_series(rng, n)
+    y2 = np.cumsum(rng.normal(size=n)).astype(np.float32)
+    ys = jnp.stack([jnp.array(y1), jnp.array(y2)])
+    exogs = jnp.zeros((2, n, 0))
+    orders = jnp.array([[1, 0, 0], [0, 1, 1]])
+    vres = jax.vmap(lambda y, x, o: sarimax_fit(CFG0, y, x, o))(ys, exogs, orders)
+    single = sarimax_fit(CFG0, jnp.array(y1), jnp.zeros((n, 0)), jnp.array([1, 0, 0]))
+    np.testing.assert_allclose(
+        np.asarray(vres.loglike[0]), float(single.loglike), rtol=1e-4
+    )
+
+
+def test_sarimax_padding_mask(rng):
+    # Tail-padded series with n_valid must match the truncated computation —
+    # the contract that lets variable-length groups share one vmapped fit.
+    y = _ar1_series(rng, 250)
+    params = np.zeros(CFG0.n_params, np.float32)
+    params[0] = 0.6
+    ll_trunc = float(
+        sarimax_loglike(CFG0, jnp.array(params), jnp.array(y[:200]), jnp.zeros((200, 0)), jnp.array([1, 0, 0]), 200)
+    )
+    padded = np.concatenate([y[:200], np.full(50, 1e3, np.float32)])
+    ll_pad = float(
+        sarimax_loglike(CFG0, jnp.array(params), jnp.array(padded), jnp.zeros((250, 0)), jnp.array([1, 0, 0]), 200)
+    )
+    assert abs(ll_trunc - ll_pad) < 1e-2
