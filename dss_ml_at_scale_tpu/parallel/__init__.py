@@ -7,3 +7,10 @@ from .trainer import (  # noqa: F401
     TrainState,
 )
 from .trials import DeviceTrials  # noqa: F401
+from .group_apply import (  # noqa: F401
+    PaddedGroups,
+    batched_fmin,
+    device_put_groups,
+    group_apply,
+    pad_groups,
+)

@@ -1,0 +1,219 @@
+"""Group-apply engine — the ``groupBy().applyInPandas()`` replacement.
+
+Reference contract (SURVEY.md §2.2 X3, §3.3): hash-partition rows so each
+(Product, SKU) group lands in its own Spark task, run an arbitrary
+pandas→pandas function per group, union the results
+(``group_apply/02_Fine_Grained_Demand_Forecasting.py:516-528``). Two
+TPU-native execution paths replace that:
+
+1. :func:`group_apply` — the **host path**: groups hash-sharded across
+   processes (multi-host) and a worker pool within each process. Runs
+   any Python function per group, exactly like ``applyInPandas``; this
+   is the compatibility surface.
+2. :func:`pad_groups` + :func:`device_put_groups` + :func:`batched_fmin`
+   — the **device path**: groups padded to a rectangle, stacked, sharded
+   over a ``Mesh`` axis, and fitted by ONE ``vmap``-compiled program.
+   Thousands of per-SKU fits become a single XLA launch instead of
+   thousands of Python processes; per-group sequential HPO becomes
+   per-round batched proposals (same TPE semantics, different execution
+   shape — SURVEY.md §7 build-plan step 7).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, NamedTuple, Sequence
+
+import numpy as np
+import pandas as pd
+
+from ..hpo.tpe import TPE
+
+
+def stable_group_hash(key: tuple) -> int:
+    """Deterministic cross-process hash of a group key (Spark-shuffle-like)."""
+    digest = hashlib.md5(repr(key).encode()).digest()
+    return int.from_bytes(digest[:8], "little")
+
+
+def shard_of(key: tuple, process_count: int) -> int:
+    return stable_group_hash(key) % process_count
+
+
+def group_apply(
+    df: pd.DataFrame,
+    keys: str | Sequence[str],
+    fn: Callable[[pd.DataFrame], pd.DataFrame],
+    *,
+    num_workers: int | None = None,
+    process_index: int = 0,
+    process_count: int = 1,
+    on_error: str = "raise",
+) -> pd.DataFrame:
+    """Apply ``fn`` to each key-group of ``df``; concat the results.
+
+    Multi-host: each process computes the same deterministic key→shard
+    hash and runs only its own groups; callers concatenate per-host
+    outputs (or write them to a common Parquet dataset, the usual sink).
+    ``on_error='skip'`` gives SparkTrials-style per-group failure
+    isolation: a failing group is dropped, the rest proceed.
+    """
+    if on_error not in ("raise", "skip"):
+        raise ValueError(f"on_error must be 'raise' or 'skip', got {on_error!r}")
+    keys = [keys] if isinstance(keys, str) else list(keys)
+    groups = [
+        (k if isinstance(k, tuple) else (k,), g)
+        for k, g in df.groupby(keys, sort=True)
+    ]
+    mine = [(k, g) for k, g in groups if shard_of(k, process_count) == process_index]
+
+    def run(item):
+        key, g = item
+        try:
+            return fn(g.reset_index(drop=True))
+        except Exception:
+            if on_error == "raise":
+                raise
+            return None
+
+    if num_workers is None or num_workers > 1:
+        with ThreadPoolExecutor(max_workers=num_workers) as pool:
+            outs = list(pool.map(run, mine))
+    else:
+        outs = [run(item) for item in mine]
+    outs = [o for o in outs if o is not None]
+    if not outs:
+        return pd.DataFrame()
+    return pd.concat(outs, ignore_index=True)
+
+
+# -- device path: pad → stack → shard → vmap ---------------------------------
+
+
+class PaddedGroups(NamedTuple):
+    """A rectangularized group panel ready for a vmapped fit."""
+
+    values: dict[str, np.ndarray]  # column -> (G, L) float32, zero-padded
+    n_valid: np.ndarray  # (G,) true length per group
+    keys: pd.DataFrame  # (G, len(keys)) group keys, row i = group i
+    n_groups: int  # true group count (before any mesh padding)
+
+
+def pad_groups(
+    df: pd.DataFrame,
+    keys: str | Sequence[str],
+    columns: Sequence[str],
+    sort_by: str | None = None,
+    max_len: int | None = None,
+) -> PaddedGroups:
+    """Stack per-group columns into (G, L) arrays with validity lengths.
+
+    The tail is zero-padded; consumers use ``n_valid`` masks (the ops
+    kernels take ``n_valid`` directly). ``sort_by`` orders rows within a
+    group first — the reference sorts by Date (``02...py:422``).
+    """
+    keys = [keys] if isinstance(keys, str) else list(keys)
+    grouped = [
+        (k if isinstance(k, tuple) else (k,), g) for k, g in df.groupby(keys, sort=True)
+    ]
+    if sort_by is not None:
+        grouped = [(k, g.sort_values(sort_by)) for k, g in grouped]
+    lengths = np.array([len(g) for _, g in grouped])
+    L = int(max_len or lengths.max())
+    if (lengths > L).any():
+        raise ValueError(f"group length {lengths.max()} exceeds max_len {L}")
+    G = len(grouped)
+    values = {c: np.zeros((G, L), np.float32) for c in columns}
+    for i, (_, g) in enumerate(grouped):
+        for c in columns:
+            values[c][i, : lengths[i]] = g[c].to_numpy(np.float32, copy=False)
+    key_frame = pd.DataFrame([k for k, _ in grouped], columns=keys)
+    return PaddedGroups(values, lengths, key_frame, G)
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int) -> np.ndarray:
+    """Pad axis 0 with copies of row 0 so G divides the mesh axis evenly.
+
+    Dummy groups are real (duplicate) work discarded by the caller via
+    ``PaddedGroups.n_groups`` — simpler and cheaper than masking inside
+    the compiled fit.
+    """
+    g = arr.shape[0]
+    pad = (-g) % multiple
+    if pad == 0:
+        return arr
+    return np.concatenate([arr, np.repeat(arr[:1], pad, axis=0)], axis=0)
+
+
+def device_put_groups(tree, mesh, axis_name: str = "data"):
+    """Shard a pytree of (G, ...) arrays over one mesh axis (group-parallel).
+
+    Pads G to a multiple of the axis size (duplicating group 0), then
+    ``device_put``s with ``NamedSharding(P(axis_name))`` so a following
+    ``jit(vmap(fit))`` runs SPMD across the slice — the pjit-across-pod
+    execution SURVEY.md §2.3 assigns to group parallelism.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis_name]
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(pad_to_multiple(np.asarray(a), n), sharding), tree
+    )
+
+
+# -- nested HPO, batched ------------------------------------------------------
+
+
+def batched_fmin(
+    evaluate_batch: Callable[[list[dict]], np.ndarray],
+    space,
+    max_evals: int,
+    n_groups: int,
+    rstate: int | np.random.Generator | Sequence = 123,
+    algo: TPE | None = None,
+) -> tuple[list[dict], list[list[tuple[dict, float]]]]:
+    """Run ``n_groups`` independent TPE searches with batched evaluation.
+
+    The reference nests a sequential ``fmin(max_evals=10)`` inside every
+    SKU's pandas UDF (``02...py:461-469``). Here each round proposes one
+    point per group (host-side TPE, cheap) and ``evaluate_batch`` scores
+    ALL groups at once — built to be one vmapped SARIMAX fit per round.
+    Search semantics per group are unchanged: each group keeps its own
+    history and proposal stream (the reference even seeds every SKU with
+    the same rstate=123, reproduced by the scalar-``rstate`` default).
+
+    Returns per-group best points and full histories. Groups whose
+    evaluation returns a non-finite loss record it as a failed trial
+    (excluded from history), preserving trial-failure isolation.
+    """
+    algo = algo or TPE()
+    if isinstance(rstate, (int, np.integer)):
+        rngs = [np.random.default_rng(rstate) for _ in range(n_groups)]
+    elif isinstance(rstate, np.random.Generator):
+        # One shared generator would entangle the groups' proposal
+        # streams; spawn independent children instead.
+        rngs = rstate.spawn(n_groups)
+    else:
+        rngs = list(rstate)
+        if len(rngs) != n_groups:
+            raise ValueError(f"need {n_groups} rstates, got {len(rngs)}")
+
+    histories: list[list[tuple[dict, float]]] = [[] for _ in range(n_groups)]
+    for _ in range(max_evals):
+        points = [algo.suggest(space, histories[g], rngs[g]) for g in range(n_groups)]
+        losses = np.asarray(evaluate_batch(points), float)
+        if losses.shape != (n_groups,):
+            raise ValueError(f"evaluate_batch returned {losses.shape}, want ({n_groups},)")
+        for g in range(n_groups):
+            if np.isfinite(losses[g]):
+                histories[g].append((points[g], float(losses[g])))
+
+    best = []
+    for g in range(n_groups):
+        if not histories[g]:
+            raise ValueError(f"group {g}: no successful trials")
+        best.append(min(histories[g], key=lambda pl: pl[1])[0])
+    return best, histories

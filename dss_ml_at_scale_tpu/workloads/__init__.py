@@ -1,0 +1,24 @@
+"""End-user workloads composing the framework layers.
+
+Each module mirrors one reference notebook track (SURVEY.md §3) built on
+the TPU-native substrate: ``forecasting`` is the per-SKU fit-tune-score
+pipeline of ``group_apply/02_Fine_Grained_Demand_Forecasting.py``.
+"""
+
+from .forecasting import (
+    EXO_FIELDS,
+    SEARCH_SPACE,
+    add_exo_variables,
+    build_tune_and_score_model,
+    split_train_score_data,
+    tune_and_forecast_panel,
+)
+
+__all__ = [
+    "EXO_FIELDS",
+    "SEARCH_SPACE",
+    "add_exo_variables",
+    "build_tune_and_score_model",
+    "split_train_score_data",
+    "tune_and_forecast_panel",
+]

@@ -1,0 +1,219 @@
+"""Tests for the group-apply engine and the forecasting workload."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dss_ml_at_scale_tpu.hpo import Trials, fmin, hp
+from dss_ml_at_scale_tpu.ops import SarimaxConfig
+from dss_ml_at_scale_tpu.parallel.group_apply import (
+    batched_fmin,
+    device_put_groups,
+    group_apply,
+    pad_groups,
+    pad_to_multiple,
+    shard_of,
+)
+from dss_ml_at_scale_tpu.runtime import make_mesh
+from dss_ml_at_scale_tpu.workloads import (
+    add_exo_variables,
+    split_train_score_data,
+    tune_and_forecast_panel,
+)
+
+
+def _demand_frame(rng, n_sku=4, weeks=60):
+    dates = pd.date_range("2019-06-03", periods=weeks, freq="W-MON")
+    rows = []
+    for s in range(n_sku):
+        base = 100 + 10 * s
+        demand = base + 0.4 * np.arange(weeks) + rng.normal(0, 3, weeks)
+        rows.append(
+            pd.DataFrame(
+                {
+                    "Date": dates,
+                    "Product": f"P{s % 2}",
+                    "SKU": f"SKU{s}",
+                    "Demand": demand.astype(np.float32),
+                }
+            )
+        )
+    return pd.concat(rows, ignore_index=True)
+
+
+# -- host path ----------------------------------------------------------------
+
+
+def test_group_apply_concat(rng):
+    df = _demand_frame(rng)
+
+    def summarize(g):
+        return pd.DataFrame(
+            {"SKU": [g["SKU"].iloc[0]], "mean": [g["Demand"].mean()]}
+        )
+
+    out = group_apply(df, "SKU", summarize)
+    assert sorted(out["SKU"]) == [f"SKU{i}" for i in range(4)]
+    assert np.isfinite(out["mean"]).all()
+
+
+def test_group_apply_multihost_shards_partition(rng):
+    df = _demand_frame(rng, n_sku=7)
+    fn = lambda g: g.head(1)[["Product", "SKU"]]
+    parts = [
+        group_apply(df, ["Product", "SKU"], fn, process_index=i, process_count=3)
+        for i in range(3)
+    ]
+    union = pd.concat([p for p in parts if len(p)], ignore_index=True)
+    assert len(union) == 7  # disjoint and complete
+    assert set(union["SKU"]) == set(df["SKU"])
+    # Deterministic assignment: same hash every call.
+    assert shard_of(("P0", "SKU0"), 3) == shard_of(("P0", "SKU0"), 3)
+
+
+def test_group_apply_failure_isolation(rng):
+    df = _demand_frame(rng)
+
+    def fn(g):
+        if g["SKU"].iloc[0] == "SKU2":
+            raise RuntimeError("boom")
+        return g.head(1)[["SKU"]]
+
+    with pytest.raises(RuntimeError):
+        group_apply(df, "SKU", fn)
+    out = group_apply(df, "SKU", fn, on_error="skip")
+    assert set(out["SKU"]) == {"SKU0", "SKU1", "SKU3"}
+
+
+# -- padding / device placement ----------------------------------------------
+
+
+def test_pad_groups_ragged():
+    df = pd.DataFrame(
+        {
+            "k": ["a"] * 3 + ["b"] * 5,
+            "t": [2, 0, 1] + [4, 3, 2, 1, 0],
+            "v": [2.0, 0.0, 1.0, 14.0, 13.0, 12.0, 11.0, 10.0],
+        }
+    )
+    padded = pad_groups(df, "k", ["v"], sort_by="t")
+    assert padded.values["v"].shape == (2, 5)
+    np.testing.assert_array_equal(padded.n_valid, [3, 5])
+    np.testing.assert_allclose(padded.values["v"][0], [0, 1, 2, 0, 0])
+    np.testing.assert_allclose(padded.values["v"][1], [10, 11, 12, 13, 14])
+    assert list(padded.keys["k"]) == ["a", "b"]
+
+
+def test_pad_to_multiple_and_mesh_sharding(devices8):
+    mesh = make_mesh({"data": 8})
+    arr = np.arange(5 * 4, dtype=np.float32).reshape(5, 4)
+    out = device_put_groups(arr, mesh)
+    assert out.shape == (8, 4)  # padded 5 -> 8
+    assert len(out.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(out)[:5], arr)
+    assert pad_to_multiple(arr, 5).shape == (5, 4)  # no-op when divisible
+
+
+# -- batched nested HPO -------------------------------------------------------
+
+
+def test_batched_fmin_matches_sequential_fmin():
+    # One group, deterministic objective: the batched driver must replay
+    # the exact proposal stream of the sequential fmin (same TPE, same rng).
+    space = {"x": hp.uniform("x", 0, 10)}
+    obj = lambda p: (p["x"] - 3.0) ** 2
+
+    trials = Trials()
+    fmin(obj, space, max_evals=12, trials=trials, rstate=7)
+    seq_points = [t["point"]["x"] for t in trials.trials]
+
+    best, hist = batched_fmin(
+        lambda pts: np.array([obj(pts[0])]), space, 12, 1,
+        rstate=[np.random.default_rng(7)],
+    )
+    batch_points = [p["x"] for p, _ in hist[0]]
+    np.testing.assert_allclose(batch_points, seq_points, rtol=1e-12)
+    assert abs(best[0]["x"] - 3.0) < 1.0
+
+
+def test_batched_fmin_independent_groups():
+    # Different per-group optima; every group must find its own.
+    targets = np.array([1.0, 5.0, 8.0])
+    space = {"x": hp.uniform("x", 0, 10)}
+
+    def evaluate(points):
+        xs = np.array([p["x"] for p in points])
+        return (xs - targets) ** 2
+
+    best, hist = batched_fmin(evaluate, space, 25, 3, rstate=np.random.default_rng(0))
+    found = np.array([b["x"] for b in best])
+    np.testing.assert_allclose(found, targets, atol=1.2)
+    # Intermittent non-finite losses are dropped per group, not fatal.
+    calls = {"n": 0}
+
+    def eval_nan(points):
+        out = (np.array([p["x"] for p in points]) - targets) ** 2
+        if calls["n"] < 2:
+            out[1] = np.nan
+        calls["n"] += 1
+        return out
+
+    _, hist2 = batched_fmin(eval_nan, space, 5, 3, rstate=0)
+    assert len(hist2[1]) == 3  # 2 failed rounds excluded
+    assert np.isfinite([l for _, l in hist2[1]]).all()
+    # An all-failing group raises, mirroring fmin's "no successful trials".
+    with pytest.raises(ValueError, match="no successful trials"):
+        batched_fmin(
+            lambda pts: np.full(3, np.nan), space, 2, 3, rstate=0
+        )
+
+
+# -- forecasting workload -----------------------------------------------------
+
+CFG_SMALL = SarimaxConfig(max_p=2, max_d=1, max_q=2, k_exog=3, max_iter=60)
+
+
+def test_add_exo_variables_flags():
+    dates = pd.to_datetime(["2019-12-23", "2020-01-13", "2020-03-02", "2019-07-01"])
+    df = pd.DataFrame(
+        {"Date": dates, "Product": "P", "SKU": "S", "Demand": [1.0, 2.0, 3.0, 4.0]}
+    )
+    out = add_exo_variables(df)
+    np.testing.assert_array_equal(out["covid"], [0, 0, 1, 0])  # breakpoint 2020-03-01
+    np.testing.assert_array_equal(out["christmas"], [1, 0, 0, 0])  # ISO weeks 51-52
+    np.testing.assert_array_equal(out["new_year"], [0, 1, 0, 0])  # ISO weeks 1-4
+    assert list(out.columns) == ["Date", "Product", "SKU", "Demand", "covid", "christmas", "new_year"]
+
+
+def test_split_train_score():
+    df = pd.DataFrame({"x": range(100)})
+    train, score = split_train_score_data(df, 40)
+    assert len(train) == 60 and len(score) == 40
+    assert score["x"].iloc[0] == 60
+
+
+def test_tune_and_forecast_panel(rng):
+    df = add_exo_variables(_demand_frame(rng, n_sku=3, weeks=60))
+    out = tune_and_forecast_panel(
+        df, max_evals=3, forecast_horizon=12, cfg=CFG_SMALL
+    )
+    assert list(out.columns) == ["Product", "SKU", "Date", "Demand", "Demand_Fitted"]
+    assert len(out) == len(df)
+    assert np.isfinite(out["Demand_Fitted"]).all()
+    # Holdout forecasts must track the trend within a loose band.
+    last = out.groupby("SKU").tail(12)
+    mape = np.abs(last["Demand_Fitted"] - last["Demand"]) / last["Demand"]
+    assert mape.median() < 0.25
+
+
+def test_tune_and_forecast_panel_mesh(rng, devices8):
+    mesh = make_mesh({"data": 8})
+    df = add_exo_variables(_demand_frame(rng, n_sku=3, weeks=60))
+    out = tune_and_forecast_panel(
+        df, max_evals=2, forecast_horizon=12, cfg=CFG_SMALL, mesh=mesh
+    )
+    assert len(out) == len(df)
+    assert np.isfinite(out["Demand_Fitted"]).all()
