@@ -1,0 +1,31 @@
+"""Synthetic data generators (SURVEY.md §2 R7, R9, R10).
+
+TPU-native rebuild of the reference's in-cluster fixtures: the weekly
+demand panel (ARMA per SKU with COVID/holiday factors), the
+bill-of-materials DAG, and the targeted-byte-size regression sets used
+by the HPO data-shipping playbook.
+"""
+
+from .bom import BomTables, generate_bom, write_bom_delta
+from .demand import (
+    DemandConfig,
+    generate_demand,
+    product_hierarchy,
+    weekly_date_spine,
+    write_demand_delta,
+)
+from .regression import gen_data, train_and_eval, tune_alpha
+
+__all__ = [
+    "BomTables",
+    "generate_bom",
+    "write_bom_delta",
+    "product_hierarchy",
+    "DemandConfig",
+    "generate_demand",
+    "weekly_date_spine",
+    "write_demand_delta",
+    "gen_data",
+    "train_and_eval",
+    "tune_alpha",
+]

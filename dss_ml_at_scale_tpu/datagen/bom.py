@@ -1,0 +1,109 @@
+"""Synthetic bill-of-materials DAG generator (reference R10).
+
+Reproduces ``group_apply/_resources/01-data-generator.py:362-543``: a
+pool of random 5-char material ids, a 3-level random DAG per SKU
+(fan-out 2–4, at most 3 nodes extended per level), edge quantities
+(1 for edges into SKUs, else 1–3), then the split into the ``bom``
+edge table and the ``sku_mapper`` (final material → SKU) table by
+SKU-prefix pattern.
+
+Differences by design: the id pool is drawn per-call from a seeded
+generator (the reference pops from an *unordered set* of 1M pre-drawn
+ids — nondeterministic iteration order despite the seed), and pool size
+defaults to just-enough instead of 1M.
+"""
+
+from __future__ import annotations
+
+import re
+import string
+from typing import NamedTuple, Sequence
+
+import numpy as np
+import pandas as pd
+
+_SKU_PATTERN = re.compile(r"SRL|LRL|CAM|SRR|LRR_.*")
+
+
+class BomTables(NamedTuple):
+    bom: pd.DataFrame  # material_in -> material_out edges with qty
+    sku_mapper: pd.DataFrame  # final_mat_number -> sku
+    graph: "object"  # the full networkx.DiGraph (for EDA parity)
+
+
+def _material_ids(rng: np.random.Generator, n: int) -> list[str]:
+    chars = string.ascii_uppercase + string.digits
+    seen: set[str] = set()
+    out: list[str] = []
+    while len(out) < n:
+        mid = "".join(chars[i] for i in rng.integers(0, len(chars), 5))
+        if mid not in seen:
+            seen.add(mid)
+            out.append(mid)
+    return out
+
+
+def generate_bom(skus: Sequence[str], depth: int = 3, seed: int = 123) -> BomTables:
+    """Build the per-SKU 3-level DAG and split bom / sku_mapper tables."""
+    import networkx as nx
+
+    rng = np.random.default_rng(seed)
+    # Worst case per SKU: 1 head + 3 levels × 3 extended × 4 children.
+    pool = iter(_material_ids(rng, len(skus) * (1 + 3 * 4 + 3 * 4) + 16))
+
+    edges: list[tuple[str, str]] = []
+    for sku in skus:
+        frontier: list[str] = []
+        for level in range(1, depth + 1):
+            if level == 1:
+                head = next(pool)
+                edges.append((head, sku))
+                frontier = [head]
+            else:
+                new_frontier: list[str] = []
+                for node in frontier[:3]:  # reference extends at most 3
+                    for _ in range(int(rng.integers(2, 5))):  # fan-out 2-4
+                        child = next(pool)
+                        edges.append((child, node))
+                        new_frontier.append(child)
+                frontier = new_frontier
+
+    g = nx.DiGraph()
+    g.add_edges_from(edges)
+    edge_df = nx.to_pandas_edgelist(g)
+    # qty: 1 into a SKU (targets of length 10), else uniform 1-3 (``:468-469``).
+    edge_df["qty"] = np.where(
+        edge_df["target"].str.len() == 10,
+        1,
+        rng.integers(1, 4, size=len(edge_df)),
+    )
+
+    into_sku = edge_df["target"].str.match(_SKU_PATTERN)
+    sku_mapper = (
+        edge_df[into_sku][["source", "target"]]
+        .rename(columns={"source": "final_mat_number", "target": "sku"})
+        .reset_index(drop=True)
+    )
+    bom = (
+        edge_df[~into_sku]
+        .rename(columns={"source": "material_in", "target": "material_out"})
+        .reset_index(drop=True)
+    )
+    return BomTables(bom, sku_mapper, g)
+
+
+def write_bom_delta(tables: BomTables, bom_path, mapper_path) -> tuple[str, str]:
+    """Persist both tables as Delta (reference ``:501-530``)."""
+    import pyarrow as pa
+
+    from ..data.delta import write_delta
+
+    write_delta(
+        pa.Table.from_pandas(tables.bom, preserve_index=False), bom_path, mode="overwrite"
+    )
+    write_delta(
+        pa.Table.from_pandas(tables.sku_mapper, preserve_index=False),
+        mapper_path,
+        mode="overwrite",
+    )
+    return str(bom_path), str(mapper_path)

@@ -1,0 +1,182 @@
+"""Synthetic weekly parts-demand generator (reference R9).
+
+Reproduces ``group_apply/_resources/01-data-generator.py:35-358``: 5
+products × n SKUs, a 3-year Monday-aligned weekly spine, per-product
+ARMA parameters from seeded draws, per-SKU ARMA series, then the factor
+algebra — COVID decline ramp (20%→7% after 2020-03-01), Christmas /
+New-Year weekly factors, and a pre-COVID ``100·sqrt(t)`` trend —
+finally rounded (``:276-306``).
+
+TPU-first difference: the reference generates one series per SKU inside
+a pandas UDF per Spark task; here every SKU's ARMA draw is ONE
+``vmap``'d :func:`..ops.arma_generate_sample` call on device (padded
+lag polynomials), and the factor algebra is vectorized NumPy. A
+deliberate fix over the reference: its UDF reseeds ``np.random.seed(123)``
+per group, making all SKUs of a product identical; here each SKU gets
+an independent fold of the seed (``:242-254`` vs this module).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as dt
+import string
+
+import numpy as np
+import pandas as pd
+
+PRODUCTS = [
+    ("Long Range Lidar", "LRL"),
+    ("Short Range Lidar", "SRL"),
+    ("Camera", "CAM"),
+    ("Long Range Radar", "LRR"),
+    ("Short Range Radar", "SRR"),
+]
+
+_XMAS_FACTORS = {51: 0.85, 52: 0.8, 1: 1.1, 2: 1.15, 3: 1.1, 4: 1.05}
+
+
+@dataclasses.dataclass(frozen=True)
+class DemandConfig:
+    """Knobs mirroring the reference's parameter cell (``:57-63``)."""
+
+    n_skus_per_product: int = 10
+    ts_length_years: int = 3
+    end_date: dt.date = dt.date(2021, 7, 19)
+    corona_breakpoint: dt.date = dt.date(2020, 3, 1)
+    pct_decrease_from: float = 20.0
+    pct_decrease_to: float = 7.0
+    trend_factor_before_corona: float = 100.0
+    seed: int = 123
+    max_arma_order: int = 3  # AR/MA lengths drawn in [1, 3] (``:207-210``)
+
+
+def weekly_date_spine(cfg: DemandConfig = DemandConfig()) -> pd.DataFrame:
+    """Common Monday-aligned weekly spine + factor columns (``:135-181``)."""
+    end = pd.Timestamp(cfg.end_date)
+    end = end - pd.Timedelta(days=end.weekday())  # the Monday on/before
+    start = end - pd.Timedelta(weeks=52 * cfg.ts_length_years)
+    dates = pd.date_range(start, end, freq="W-MON")
+    df = pd.DataFrame({"Date": dates})
+
+    # COVID helper: 0 before the breakpoint, then 0,1,2,... counting up
+    # (the reference's help_list construction, ``:149-155``).
+    after = np.flatnonzero(dates >= pd.Timestamp(cfg.corona_breakpoint))
+    helper = np.zeros(len(dates), int)
+    if len(after):
+        b = after[0]
+        helper[b - 1 :] = np.arange(len(dates) - b + 1)
+    df["Corona_Breakpoint_Helper"] = helper
+
+    span = max(helper.max(), 1)
+    pct = np.where(
+        helper > 0,
+        cfg.pct_decrease_from
+        - (cfg.pct_decrease_from - cfg.pct_decrease_to) / span * helper,
+        0.0,
+    )
+    df["Corona_Factor"] = np.where(helper == 0, 1.0, (100.0 - pct) / 100.0)
+
+    week = dates.isocalendar().week.to_numpy()
+    df["Week"] = week
+    df["Factor_XMas"] = np.array([_XMAS_FACTORS.get(int(w), 1.0) for w in week])
+    return df
+
+
+def _id_generator(rng: np.random.Generator, size: int = 6) -> str:
+    chars = string.ascii_uppercase + string.digits
+    return "".join(chars[i] for i in rng.integers(0, len(chars), size))
+
+
+def product_hierarchy(cfg: DemandConfig = DemandConfig()) -> pd.DataFrame:
+    """Product → SKU table: ``{PREFIX}_{6-char id}`` per SKU (``:96-127``)."""
+    rng = np.random.default_rng(cfg.seed)
+    rows = []
+    for product, prefix in PRODUCTS:
+        seen: set[str] = set()
+        while len(seen) < cfg.n_skus_per_product:
+            seen.add(_id_generator(rng))
+        rows += [(product, f"{prefix}_{postfix}") for postfix in sorted(seen)]
+    return pd.DataFrame(rows, columns=["Product", "SKU"])
+
+
+def _arma_product_params(cfg: DemandConfig, rng: np.random.Generator):
+    """Per-product variance/offset/AR/MA draws (``:197-226``)."""
+    n = len(PRODUCTS)
+    variance = np.abs(rng.normal(100, 50, n))
+    offset = np.maximum(np.abs(rng.normal(10000, 5000, n)), 4000)
+    ar_len = rng.integers(1, cfg.max_arma_order + 1, n)
+    ma_len = rng.integers(1, cfg.max_arma_order + 1, n)
+    ar = [rng.uniform(0.1, 0.9, k) for k in ar_len]
+    ma = [rng.uniform(0.1, 0.9, k) for k in ma_len]
+    return variance, offset, ar, ma
+
+
+def generate_demand(cfg: DemandConfig = DemandConfig()) -> pd.DataFrame:
+    """The full demand panel: [Product, SKU, Date, Demand] long frame."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import arma_generate_sample
+
+    spine = weekly_date_spine(cfg)
+    hierarchy = product_hierarchy(cfg)
+    rng = np.random.default_rng(cfg.seed)
+    variance, offset, ar, ma = _arma_product_params(cfg, rng)
+
+    n_weeks = len(spine)
+    m = cfg.max_arma_order
+    # Pad per-product lag polynomials ([1, a1..ak] style, the statsmodels
+    # np.r_[1, params] convention at ``:246``) to a common length so one
+    # vmapped draw covers every SKU.
+    G = len(hierarchy)
+    prod_idx = hierarchy["Product"].map(
+        {p: i for i, (p, _) in enumerate(PRODUCTS)}
+    ).to_numpy()
+    ar_poly = np.zeros((G, m + 1), np.float32)
+    ma_poly = np.zeros((G, m + 1), np.float32)
+    for g, pi in enumerate(prod_idx):
+        ar_poly[g, 0] = ma_poly[g, 0] = 1.0
+        ar_poly[g, 1 : 1 + len(ar[pi])] = ar[pi]
+        ma_poly[g, 1 : 1 + len(ma[pi])] = ma[pi]
+    scale = variance[prod_idx].astype(np.float32)
+    off = offset[prod_idx].astype(np.float32)
+
+    keys = jax.random.split(jax.random.key(cfg.seed), G)
+    draw = jax.vmap(
+        lambda k, a, b, s: arma_generate_sample(k, a, b, n_weeks, scale=s, burnin=3000)
+    )
+    panel = np.asarray(draw(keys, jnp.array(ar_poly), jnp.array(ma_poly), jnp.array(scale)))
+    panel = panel + off[:, None]
+
+    # Factor algebra (``:295-306``): COVID decline, pre-COVID sqrt trend,
+    # Christmas/New-Year factors, rounding.
+    corona = spine["Corona_Factor"].to_numpy()
+    helper = spine["Corona_Breakpoint_Helper"].to_numpy()
+    xmas = spine["Factor_XMas"].to_numpy()
+    rows = np.arange(n_weeks)
+    panel = panel * corona[None, :]
+    pre = helper == 0
+    panel[:, pre] += cfg.trend_factor_before_corona * np.sqrt(rows[pre])[None, :]
+    panel = np.round(panel * xmas[None, :])
+
+    out = pd.DataFrame(
+        {
+            "Product": np.repeat(hierarchy["Product"].to_numpy(), n_weeks),
+            "SKU": np.repeat(hierarchy["SKU"].to_numpy(), n_weeks),
+            "Date": np.tile(spine["Date"].to_numpy(), G),
+            "Demand": panel.reshape(-1).astype(np.float32),
+        }
+    )
+    assert len(out) == G * n_weeks, "row-count invariant (reference :125)"
+    return out
+
+
+def write_demand_delta(df: pd.DataFrame, path) -> str:
+    """Persist the panel as a Delta table (reference ``:336-349``)."""
+    import pyarrow as pa
+
+    from ..data.delta import write_delta
+
+    write_delta(pa.Table.from_pandas(df, preserve_index=False), path, mode="overwrite")
+    return str(path)

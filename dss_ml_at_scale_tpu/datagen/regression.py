@@ -1,0 +1,59 @@
+"""Targeted-byte-size regression fixtures + the tune-alpha playbook.
+
+Reproduces the utility trio of ``hyperopt/2. hyperopt on diff sizes of
+data.py:25-56``: ``gen_data(bytes)`` (synthetic regression sized to a
+byte budget, the size-sensitivity harness SURVEY.md §4.4 calls out),
+``train_and_eval`` (Lasso fit/score), and ``tune_alpha`` (4-eval TPE
+sweep at parallelism 2 — here on the device-pinned executor instead of
+SparkTrials).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def gen_data(n_bytes: int, n_features: int = 100):
+    """Train/test split of a regression problem totalling ~``n_bytes``.
+
+    Same arithmetic as the reference (``:25-33``): float64 rows of
+    ``n_features + 1`` values, so ``n_samples = bytes / ((F+1) * 8)``.
+    """
+    from sklearn import datasets, model_selection
+
+    n_samples = int((1.0 * n_bytes / (n_features + 1)) / 8)
+    X, y = datasets.make_regression(
+        n_samples=n_samples, n_features=n_features, random_state=0
+    )
+    return model_selection.train_test_split(X, y, test_size=0.2, random_state=1)
+
+
+def train_and_eval(data, alpha: float) -> dict:
+    """Lasso fit + R² score, the reference's objective body (``:35-43``).
+
+    Kept sklearn-backed on purpose: the capability under test is
+    "arbitrary Python objective under distributed HPO" (SURVEY.md §2.2
+    X11), not the model itself.
+    """
+    from sklearn import linear_model
+
+    X_train, X_test, y_train, y_test = data
+    model = linear_model.Lasso(alpha=alpha)
+    model.fit(X_train, y_train)
+    loss = model.score(X_test, y_test)
+    return {"loss": loss, "status": "ok"}
+
+
+def tune_alpha(objective, parallelism: int = 2, max_evals: int = 4) -> float:
+    """4-eval TPE sweep over alpha on the parallel executor (``:45-56``)."""
+    from ..hpo import fmin, hp
+    from ..parallel import DeviceTrials
+
+    best = fmin(
+        objective,
+        hp.uniform("alpha", 0.0, 10.0),
+        max_evals=max_evals,
+        trials=DeviceTrials(parallelism=parallelism),
+        rstate=np.random.default_rng(0),
+    )
+    return best["alpha"]
