@@ -1,0 +1,19 @@
+"""Image-dataset ingestion tooling (SURVEY.md §2 R1)."""
+
+from .imagenet import (
+    copy_parallel,
+    extract_object,
+    ingest_image_dataset,
+    object_id_from_path,
+    scan_binary_files,
+    xml_annotation_to_json,
+)
+
+__all__ = [
+    "copy_parallel",
+    "extract_object",
+    "ingest_image_dataset",
+    "object_id_from_path",
+    "scan_binary_files",
+    "xml_annotation_to_json",
+]

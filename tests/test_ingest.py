@@ -1,0 +1,112 @@
+"""Tests for the image-dataset ingestion tooling."""
+
+import json
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dss_ml_at_scale_tpu.data import DeltaTable, make_batch_reader
+from dss_ml_at_scale_tpu.ingest import (
+    copy_parallel,
+    extract_object,
+    ingest_image_dataset,
+    object_id_from_path,
+    xml_annotation_to_json,
+)
+
+_XML = """<annotation>
+  <folder>val</folder>
+  <filename>{name}</filename>
+  <object><name>{label}</name><bndbox><xmin>1</xmin></bndbox></object>
+  <object><name>other</name><bndbox><xmin>2</xmin></bndbox></object>
+</annotation>"""
+
+
+@pytest.fixture(scope="module")
+def image_tree(tmp_path_factory):
+    """Data/<wnid>/<wnid>_<i>.JPEG + parallel Annotations tree."""
+    root = tmp_path_factory.mktemp("ilsvrc")
+    rng = np.random.default_rng(0)
+    paths = []
+    for wnid in ("n01440764", "n02007558"):
+        ddir = root / "Data" / wnid
+        adir = root / "Annotations" / wnid
+        ddir.mkdir(parents=True)
+        adir.mkdir(parents=True)
+        for i in range(6):
+            name = f"{wnid}_{i}"
+            img = Image.fromarray(
+                (rng.random((32, 32, 3)) * 255).astype(np.uint8)
+            )
+            img.save(ddir / f"{name}.JPEG", format="JPEG")
+            (adir / f"{name}.xml").write_text(_XML.format(name=name, label=wnid))
+            paths.append(ddir / f"{name}.JPEG")
+    return root
+
+
+def test_copy_parallel(image_tree, tmp_path):
+    n = copy_parallel(image_tree / "Data", tmp_path / "flat", "*.JPEG", n_workers=4)
+    assert n == 12
+    assert len(list((tmp_path / "flat").glob("*.JPEG"))) == 12
+
+
+def test_annotation_extraction(image_tree):
+    img = str(image_tree / "Data" / "n01440764" / "n01440764_0.JPEG")
+    ann = xml_annotation_to_json(img)
+    parsed = json.loads(ann)
+    assert parsed["annotation"]["filename"] == "n01440764_0"
+    # Two <object> nodes -> list; extractor takes the first's name.
+    assert extract_object(ann) == "n01440764"
+    assert object_id_from_path(img) == "n01440764"
+    assert xml_annotation_to_json("/nope/Data/missing.JPEG") == "{}"
+    assert extract_object("{}") is None
+
+
+def test_ingest_train_split(image_tree, tmp_path):
+    table = ingest_image_dataset(
+        image_tree / "Data", tmp_path / "train.delta", rows_per_fragment=5
+    )
+    assert table.num_records() == 12
+    assert len(table.file_uris()) == 3  # 5 + 5 + 2
+    import pyarrow.parquet as pq
+
+    frames = [pq.read_table(u) for u in table.file_uris()]
+    import pyarrow as pa
+
+    full = pa.concat_tables(frames).sort_by("id")
+    assert full["id"].to_pylist() == list(range(12))  # zipWithIndex semantics
+    labels = set(full["object_id"].to_pylist())
+    assert labels == {"n01440764", "n02007558"}
+    # Bytes survive the roundtrip as decodable JPEG.
+    import io
+
+    img = Image.open(io.BytesIO(full["content"][0].as_py()))
+    assert img.size == (32, 32)
+
+
+def test_ingest_val_split_labels_from_annotation(image_tree, tmp_path):
+    table = ingest_image_dataset(
+        image_tree / "Data",
+        tmp_path / "val.delta",
+        label_from="annotation",
+    )
+    import pyarrow.parquet as pq
+
+    got = pq.read_table(table.file_uris()[0])
+    assert set(got["object_id"].to_pylist()) == {"n01440764", "n02007558"}
+
+
+def test_ingested_table_feeds_reader(image_tree, tmp_path):
+    # The ingestion output must stream through the framework's own loader —
+    # the train-path integration the reference achieves via Petastorm.
+    table = ingest_image_dataset(image_tree / "Data", tmp_path / "feed.delta")
+    with make_batch_reader(
+        DeltaTable(tmp_path / "feed.delta"),
+        batch_size=4,
+        columns=["content", "id"],
+        num_epochs=1,
+        workers_count=2,
+    ) as reader:
+        rows = sum(len(b["id"]) for b in reader)
+    assert rows == 12
