@@ -94,21 +94,69 @@ def imagenet_transform_spec(
     *,
     content_column: str = "content",
     label_column: str = "label_index",
+    resize: int = 256,
     crop: int = 224,
     normalize: bool = True,
+    backend: str = "auto",
+    decode_threads: int | None = None,
 ) -> TransformSpec:
     """The reference's training TransformSpec, columnar.
 
     Emits ``image`` float32 (3,crop,crop) and ``label`` int32 — the same
     field contract as ``deep_learning/2...py:310-318``.
+
+    ``backend``: ``"native"`` uses the C++ decode pool
+    (:mod:`dss_ml_at_scale_tpu.native` — GIL-free libjpeg + threaded
+    resize/crop/normalize), ``"pil"`` the pure-Python path, ``"auto"``
+    native when it compiles on this host with per-image PIL fallback for
+    codecs the native path rejects (e.g. CMYK JPEGs).
     """
+    if backend not in ("auto", "native", "pil"):
+        raise ValueError(f"unknown backend {backend!r}")
+    if crop > resize:
+        # crop > resize would mean padding/stretching, and the native and
+        # PIL paths disagree on which; the reference never does it (256/224).
+        raise ValueError(f"crop ({crop}) must be <= resize ({resize})")
+
+    # Resolve the backend NOW: a missing toolchain fails at spec
+    # construction, not in the first reader worker batch, and the lazy g++
+    # compile happens here rather than under the hot path's module lock.
+    # ``decode_threads`` bounds the C++ pool per call — reader pools running
+    # several transforms concurrently should split the host's cores.
+    from .. import native
+
+    if backend == "native" and not native.native_available():
+        raise RuntimeError(native.load_error() or "native pipeline unavailable")
+    use_native = backend == "native" or (
+        backend == "auto" and native.native_available()
+    )
+
+    def _decode_pil(b: bytes) -> np.ndarray:
+        img = decode_resize_crop(b, resize=resize, crop=crop)
+        if normalize:
+            img = (img - IMAGENET_MEAN[:, None, None]) / IMAGENET_STD[:, None, None]
+        return img
 
     def _func(batch: Columnar) -> Columnar:
-        images = np.stack(
-            [decode_resize_crop(b, crop=crop) for b in batch[content_column]]
-        )
-        if normalize:
-            images = (images - IMAGENET_MEAN[:, None, None]) / IMAGENET_STD[:, None, None]
+        jpegs = [bytes(b) for b in batch[content_column]]
+        if use_native:
+            images, ok = native.decode_jpeg_batch(
+                jpegs,
+                resize=resize,
+                crop=crop,
+                mean=IMAGENET_MEAN if normalize else None,
+                std=IMAGENET_STD if normalize else None,
+                chw=True,
+                num_threads=decode_threads,
+            )
+            if not ok.all():
+                if backend == "native":
+                    bad = int((~ok).sum())
+                    raise ValueError(f"native decode failed for {bad} images")
+                for i in np.flatnonzero(~ok):
+                    images[i] = _decode_pil(jpegs[i])
+        else:
+            images = np.stack([_decode_pil(b) for b in jpegs])
         labels = np.asarray(batch[label_column], np.int32)
         return {"image": images, "label": labels}
 

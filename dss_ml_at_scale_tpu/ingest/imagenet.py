@@ -33,12 +33,24 @@ def copy_parallel(
     file_pattern: str = "*",
     n_workers: int = 100,
 ) -> int:
-    """Threaded recursive copy; returns the number of files copied."""
+    """Threaded recursive copy; returns the number of files copied.
+
+    Preserves the relative directory layout under ``dest`` (an ImageNet
+    tree has one directory per wnid with repeated filenames across dirs,
+    so flattening would silently drop copies).
+    """
+    src = Path(src)
     dest = Path(dest)
     dest.mkdir(parents=True, exist_ok=True)
-    files = sorted(Path(src).rglob(file_pattern))
+    files = [p for p in sorted(src.rglob(file_pattern)) if p.is_file()]
+
+    def _copy(p: Path) -> None:
+        target = dest / p.relative_to(src)
+        target.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(p, target)
+
     with ThreadPoolExecutor(max_workers=n_workers) as pool:
-        list(pool.map(lambda p: shutil.copy(p, dest), files))
+        list(pool.map(_copy, files))
     return len(files)
 
 
@@ -131,8 +143,28 @@ def ingest_image_dataset(
     if label_from not in ("path", "annotation"):
         raise ValueError(f"label_from must be 'path' or 'annotation', got {label_from!r}")
 
+    # Appending continues the id sequence from the existing table so ids
+    # stay unique and monotonic (zipWithIndex semantics across ingests).
+    id_start = 0
+    if mode == "append" and Path(table_path, "_delta_log").exists():
+        import pyarrow.parquet as pq
+
+        for uri in DeltaTable(table_path).file_uris():
+            # Footer statistics only — no data pages read.
+            meta = pq.ParquetFile(uri).metadata
+            col = meta.schema.to_arrow_schema().get_field_index("id")
+            for rg in range(meta.num_row_groups):
+                stats = meta.row_group(rg).column(col).statistics
+                if stats is not None and stats.has_min_max:
+                    id_start = max(id_start, stats.max + 1)
+                else:  # no stats written: fall back to reading the column
+                    ids = pq.read_table(uri, columns=["id"])["id"]
+                    if len(ids):
+                        id_start = max(id_start, ids.to_numpy().max() + 1)
+                    break
+
     def rows() -> Iterator[dict]:
-        for i, rec in enumerate(scan_binary_files(data_root, file_pattern)):
+        for i, rec in enumerate(scan_binary_files(data_root, file_pattern), start=id_start):
             ann = xml_annotation_to_json(rec["path"], data_dir, annotations_dir)
             rec["annotation"] = ann
             rec["object_id"] = (

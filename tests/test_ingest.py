@@ -46,9 +46,13 @@ def image_tree(tmp_path_factory):
 
 
 def test_copy_parallel(image_tree, tmp_path):
-    n = copy_parallel(image_tree / "Data", tmp_path / "flat", "*.JPEG", n_workers=4)
+    n = copy_parallel(image_tree / "Data", tmp_path / "out", "*.JPEG", n_workers=4)
     assert n == 12
-    assert len(list((tmp_path / "flat").glob("*.JPEG"))) == 12
+    # Relative layout preserved: wnid dirs with repeated basenames survive.
+    assert len(list((tmp_path / "out").rglob("*.JPEG"))) == 12
+    assert (tmp_path / "out" / "n01440764" / "n01440764_0.JPEG").exists()
+    # Pattern-free default must skip directories rather than crash.
+    assert copy_parallel(image_tree / "Data", tmp_path / "out2") == 12
 
 
 def test_annotation_extraction(image_tree):
@@ -110,3 +114,17 @@ def test_ingested_table_feeds_reader(image_tree, tmp_path):
     ) as reader:
         rows = sum(len(b["id"]) for b in reader)
     assert rows == 12
+
+
+def test_append_continues_id_sequence(image_tree, tmp_path):
+    import pyarrow.parquet as pq
+
+    path = tmp_path / "app.delta"
+    ingest_image_dataset(image_tree / "Data" / "n01440764", path)
+    table = ingest_image_dataset(
+        image_tree / "Data" / "n02007558", path, mode="append"
+    )
+    ids = sorted(
+        i for uri in table.file_uris() for i in pq.read_table(uri)["id"].to_pylist()
+    )
+    assert ids == list(range(12))  # unique, contiguous across both ingests
