@@ -2,3 +2,4 @@
 
 from .resnet import ResNet, ResNet18, ResNet50, ResNet101  # noqa: F401
 from .metrics import cross_entropy_loss, multiclass_accuracy  # noqa: F401
+from .transformer import RMSNorm, TransformerLM, next_token_loss  # noqa: F401

@@ -1,0 +1,139 @@
+"""Decoder-only Transformer LM with pluggable attention backends.
+
+No transformer exists in the reference (SURVEY.md §5.7) — this family is
+here because long-context is first-class in the TPU build: it is the
+workload that exercises flash attention (single device) and ring
+attention (sequence-parallel over a mesh axis), the same way ResNet-50
+exercises the data-parallel trainer.
+
+TPU-first choices: bf16 activations by default (MXU-native), RMSNorm +
+pre-norm residuals, fused-friendly GELU MLP, static shapes throughout,
+and attention selected at construction ("flash" | "ring" | "reference")
+so the same module runs single-chip or sequence-sharded without code
+changes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.flash_attention import attention_reference, flash_attention
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale).astype(self.dtype)
+
+
+def _select_attention(kind: str, **ring_kwargs) -> Callable:
+    if kind == "flash":
+        return lambda q, k, v: flash_attention(q, k, v, causal=True)
+    if kind == "reference":
+        return lambda q, k, v: attention_reference(q, k, v, causal=True)
+    if kind == "ring":
+        from ..parallel.ring import ring_attention
+
+        mesh = ring_kwargs.get("mesh")
+        axis_name = ring_kwargs.get("axis_name")
+        if mesh is None or axis_name is None:
+            raise ValueError("attention='ring' needs mesh= and axis_name=")
+        return lambda q, k, v: ring_attention(
+            q, k, v, mesh=mesh, axis_name=axis_name, causal=True
+        )
+    raise ValueError(f"unknown attention backend {kind!r}")
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    mlp_ratio: int = 4
+    attention_fn: Callable = None  # bound by TransformerLM
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, dim = x.shape
+        head_dim = dim // self.num_heads
+
+        h = RMSNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * dim, use_bias=False, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # [b, s, dim] -> [b, heads, s, head_dim]
+            return t.reshape(b, s, self.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        attn = self.attention_fn(heads(q), heads(k), heads(v))
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, dim)
+        x = x + nn.Dense(dim, use_bias=False, dtype=self.dtype, name="proj")(attn)
+
+        h = RMSNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype, name="mlp_up")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(dim, dtype=self.dtype, name="mlp_down")(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: token + learned position embeddings, N pre-norm blocks.
+
+    ``attention``: "flash" (Pallas kernel, single device), "ring"
+    (sequence-parallel — pass ``mesh`` and ``axis_name``), or "reference".
+    """
+
+    vocab_size: int
+    dim: int = 512
+    num_heads: int = 8
+    num_layers: int = 4
+    max_seq: int = 2048
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"
+    mesh: Any = None
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, tokens):  # [b, s] int32 -> [b, s, vocab] f32 logits
+        b, s = tokens.shape
+        if s > self.max_seq:
+            raise ValueError(f"seq {s} > max_seq {self.max_seq}")
+        attention_fn = _select_attention(
+            self.attention, mesh=self.mesh, axis_name=self.axis_name
+        )
+        tok = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype, name="tok_embed")
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_seq, self.dim),
+        )
+        x = tok(tokens) + pos[None, :s].astype(self.dtype)
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                num_heads=self.num_heads,
+                dtype=self.dtype,
+                mlp_ratio=self.mlp_ratio,
+                attention_fn=attention_fn,
+                name=f"block_{i}",
+            )(x)
+        x = RMSNorm(dtype=self.dtype)(x)
+        # Logits in f32 for a stable softmax cross-entropy.
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head")(x)
+
+
+def next_token_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean cross entropy of positions 0..s-2 predicting tokens 1..s-1."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -73,7 +73,10 @@ bool decode_rgb(const unsigned char* data, unsigned long size,
   jpeg_start_decompress(&cinfo);
   *w = cinfo.output_width;
   *h = cinfo.output_height;
-  if (*w <= 0 || *h <= 0 || cinfo.output_components != 3) {
+  // Cap decoded size at 512 MP (~1.5 GB RGB): beyond this is corrupt or
+  // hostile input; flag it for the caller's fallback instead of allocating.
+  if (*w <= 0 || *h <= 0 || cinfo.output_components != 3 ||
+      static_cast<long long>(*w) * *h > (512LL << 20)) {
     jpeg_destroy_decompress(&cinfo);
     return false;
   }
@@ -238,8 +241,15 @@ int dsst_decode_batch(const unsigned char* const* jpegs,
     for (;;) {
       int i = next.fetch_add(1);
       if (i >= n) return;
-      bool ok = process_one(jpegs[i], sizes[i], resize_to, crop, do_norm != 0,
-                            mean, stdv, chw != 0, out + per_image * i);
+      bool ok;
+      try {
+        ok = process_one(jpegs[i], sizes[i], resize_to, crop, do_norm != 0,
+                         mean, stdv, chw != 0, out + per_image * i);
+      } catch (...) {
+        // Per-image failure contract: an escaped exception (e.g. bad_alloc
+        // on a pathological image) must flag the row, not terminate().
+        ok = false;
+      }
       statuses[i] = ok ? 0 : 1;
       if (!ok) failures.fetch_add(1);
     }

@@ -12,6 +12,7 @@ replaces the reference's one-Spark-task-per-group Python processes
 """
 
 from .arma import arma_generate_sample, lfilter
+from .flash_attention import attention_reference, flash_attention
 from .holt_winters import HoltWintersResult, holt_winters_fit, holt_winters_forecast
 from .kalman import kalman_filter, kalman_forecast
 from .neldermead import NelderMeadResult, nelder_mead
@@ -26,6 +27,8 @@ from .sarimax import (
 __all__ = [
     "arma_generate_sample",
     "lfilter",
+    "attention_reference",
+    "flash_attention",
     "HoltWintersResult",
     "holt_winters_fit",
     "holt_winters_forecast",

@@ -1,4 +1,7 @@
-"""Distribution strategies: DP trainer, HPO executor, group-apply engine."""
+"""Distribution strategies: DP trainer, HPO executor, group-apply engine,
+ring attention (sequence parallelism)."""
+
+from .ring import ring_attention  # noqa: F401
 
 from .trainer import (  # noqa: F401
     ClassifierTask,
