@@ -233,6 +233,15 @@ def flash_attention(
     """
     if q.ndim != 4:
         raise ValueError(f"expected [batch, heads, seq, head_dim], got {q.shape}")
+    if causal and q.shape[2] > k.shape[2]:
+        # Bottom-right alignment gives the first sq - sk query rows zero
+        # visible keys: their softmax denominator is 0 and the kernel
+        # emits non-finite rows. No attention semantics want this shape.
+        raise ValueError(
+            f"causal flash attention needs sq <= sk, got sq={q.shape[2]} "
+            f"sk={k.shape[2]} (rows before the first key would attend to "
+            "nothing)"
+        )
     if interpret is None:
         interpret = not _is_tpu()
     b, h, sq, d = q.shape

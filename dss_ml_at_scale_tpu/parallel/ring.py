@@ -90,21 +90,24 @@ def _ring_local(q_l, k_l, v_l, *, axis_name, causal):
     s_local = q_l.shape[2]
     perm = [(j, (j + 1) % p_sz) for j in range(p_sz)]
 
-    out0 = jnp.zeros(q_l.shape, jnp.float32)
-    lse0 = jnp.full(q_l.shape[:3], _NEG_INF, jnp.float32)
-
     def step(carry, i):
+        # Permute first, then compute: the local (hop-0) chunk is handled
+        # outside the scan, so the ring pays exactly p_sz - 1 hops — XLA
+        # cannot DCE a trailing collective inside a scan body.
         out, lse, k_c, v_c = carry
+        k_c, v_c = jax.lax.ppermute((k_c, v_c), axis_name, perm)
         src = (my - i) % p_sz  # which global chunk is visiting this step
         o_c, lse_c = _chunk_attention(
             q_l, k_c, v_c, my * s_local, src * s_local, causal
         )
         out, lse = _merge(out, lse, o_c, lse_c)
-        k_c, v_c = jax.lax.ppermute((k_c, v_c), axis_name, perm)
         return (out, lse, k_c, v_c), None
 
+    out0, lse0 = _chunk_attention(
+        q_l, k_l, v_l, my * s_local, my * s_local, causal
+    )
     (out, _, _, _), _ = jax.lax.scan(
-        step, (out0, lse0, k_l, v_l), jnp.arange(p_sz)
+        step, (out0, lse0, k_l, v_l), jnp.arange(1, p_sz)
     )
     return out.astype(q_l.dtype)
 

@@ -40,6 +40,7 @@ from ..data.prefetch import prefetch_to_mesh
 from ..models.metrics import cross_entropy_loss, multiclass_accuracy
 from ..runtime.mesh import make_mesh
 from ..runtime.topology import local_topology
+from ..utils.profiling import StepTimer
 
 log = logging.getLogger(__name__)
 
@@ -157,6 +158,12 @@ class TrainerConfig:
     best_mode: str = "max"
     resume: bool = False
     prefetch_depth: int = 2
+    # jax.profiler trace capture (SURVEY.md §5.1): when profile_dir is
+    # set, a trace covering steps [profile_start_step,
+    # profile_start_step + profile_num_steps) is written there.
+    profile_dir: str | None = None
+    profile_start_step: int = 5
+    profile_num_steps: int = 5
 
 
 @dataclasses.dataclass
@@ -247,6 +254,8 @@ class Trainer:
         sign = 1.0 if cfg.best_mode == "max" else -1.0
         step = int(state.step)  # host-side mirror, synced once before the loop
         data_exhausted = False
+        step_timer = StepTimer()
+        tracing = False
 
         for epoch in range(start_epoch, cfg.max_epochs):
             if data_exhausted:
@@ -264,9 +273,21 @@ class Trainer:
                 except StopIteration:
                     data_exhausted = True
                     break
+                if cfg.profile_dir is not None and not tracing and (
+                    step >= cfg.profile_start_step
+                ):
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    tracing = True
+                    trace_stop_at = step + cfg.profile_num_steps
                 state, metrics = train_step(state, batch)
                 epoch_steps += 1
                 step += 1  # host-side mirror of state.step: no device sync
+                step_timer.tick()
+                if tracing and step >= trace_stop_at:
+                    jax.block_until_ready(state.params)
+                    jax.profiler.stop_trace()
+                    tracing = False
+                    cfg = dataclasses.replace(cfg, profile_dir=None)
                 if step % cfg.log_every_steps == 0:
                     self._log({k: float(v) for k, v in metrics.items()}, step)
             if epoch_steps == 0:
@@ -280,8 +301,10 @@ class Trainer:
                 * per_process_batch
                 * self.topology.process_count
                 / dt,
+                **step_timer.summary(),
                 **{k: float(v) for k, v in metrics.items()},
             }
+            step_timer.reset()
 
             if val_data_factory is not None:
                 epoch_summary.update(self._evaluate(eval_step, state, val_data_factory))
@@ -314,6 +337,9 @@ class Trainer:
                     args=_ocp().args.StandardSave(_to_pytree(state)),
                     metrics=save_metrics,
                 )
+        if tracing:
+            jax.block_until_ready(state.params)
+            jax.profiler.stop_trace()
         if manager is not None:
             manager.wait_until_finished()
 

@@ -1,0 +1,9 @@
+"""Shared utilities: profiling, step timing, diagnostics."""
+
+from .profiling import (  # noqa: F401
+    StepTimer,
+    annotate,
+    trace,
+)
+
+__all__ = ["StepTimer", "annotate", "trace"]

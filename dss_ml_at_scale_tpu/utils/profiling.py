@@ -1,0 +1,97 @@
+"""Profiling and step-timing hooks (the subsystem the reference lacks).
+
+The reference's only observability artifacts are a wall-clock epoch print
+(``deep_learning/2.distributed-data-loading-petastorm.py:184``) and debug
+batch prints gated on a logging level (``:176-179,203-206``); SURVEY.md
+§5.1 calls for real ``jax.profiler`` trace hooks plus per-step timing.
+This module provides both:
+
+- :func:`trace` — context manager around
+  ``jax.profiler.start_trace``/``stop_trace`` producing a TensorBoard /
+  XProf-loadable trace directory (XLA HLO timelines, host/device
+  activity).
+- :func:`annotate` — named ``TraceAnnotation`` so framework phases
+  (decode, device_put, train_step) show up as labeled spans.
+- :class:`StepTimer` — cheap host-side per-step wall-time recorder with
+  summary statistics. It deliberately does NOT block on device results:
+  steady-state dispatch intervals equal device step time once the
+  dispatch queue fills, and blocking every step would serialize the very
+  pipeline being measured. Call :meth:`StepTimer.summary` after a
+  ``block_until_ready`` for honest totals.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace for the enclosed block.
+
+    The resulting ``logdir`` loads in TensorBoard's profile plugin /
+    XProf and shows the XLA op timeline on device plus host-side Python
+    activity — the diagnostic the reference's epoch print stood in for.
+    """
+    options = jax.profiler.ProfileOptions()
+    options.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(logdir, profiler_options=options)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named trace span: ``with annotate("decode"): ...``."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Rolling per-step wall-time recorder.
+
+    ``tick()`` marks a step boundary; intervals between consecutive ticks
+    are recorded. The first interval after construction or :meth:`reset`
+    is discarded by :meth:`summary` when ``drop_first`` (compile step).
+    """
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._times: list[float] = []
+        self._last: float | None = None
+
+    def reset(self) -> None:
+        self._times.clear()
+        self._last = None
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            if len(self._times) >= self.capacity:
+                self._times.pop(0)
+            self._times.append(now - self._last)
+        self._last = now
+
+    @property
+    def intervals(self) -> list[float]:
+        return list(self._times)
+
+    def summary(self, *, drop_first: bool = True) -> dict[str, float]:
+        """Mean / p50 / p90 / max step seconds and steps/sec."""
+        xs = self._times[1:] if drop_first else self._times
+        if not xs:
+            return {}
+        xs_sorted = sorted(xs)
+        n = len(xs_sorted)
+        mean = sum(xs_sorted) / n
+        return {
+            "step_time_mean_s": mean,
+            "step_time_p50_s": xs_sorted[n // 2],
+            "step_time_p90_s": xs_sorted[min(n - 1, (9 * n) // 10)],
+            "step_time_max_s": xs_sorted[-1],
+            "steps_per_sec": 1.0 / mean if mean > 0 else float("inf"),
+        }

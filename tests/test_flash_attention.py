@@ -83,3 +83,12 @@ def test_rejects_ragged_seq(rng):
     q, k, v = _qkv(rng, s=100)
     with pytest.raises(ValueError, match="multiples"):
         flash_attention(q, k, v, block_q=64, block_k=64)
+
+
+def test_rejects_causal_sq_gt_sk(rng):
+    # Bottom-right-aligned causal with sq > sk leaves the first sq - sk
+    # query rows with zero visible keys (0/0 softmax); must be rejected.
+    q, _, _ = _qkv(rng, s=64)
+    _, k, v = _qkv(rng, s=32)
+    with pytest.raises(ValueError, match="sq <= sk"):
+        flash_attention(q, k, v, causal=True)
