@@ -19,6 +19,10 @@ def build_parser() -> argparse.ArgumentParser:
     sub = parser.add_subparsers(dest="command")
     info = sub.add_parser("info", help="show runtime topology and devices")
     info.set_defaults(fn=_cmd_info)
+
+    from .commands import register_all
+
+    register_all(sub)
     return parser
 
 

@@ -1,0 +1,375 @@
+"""`dsst` workload subcommands.
+
+Each subcommand is the CLI face of one reference notebook track
+(SURVEY.md §3): ``datagen`` replaces the widget-driven generator
+notebooks (``group_apply/_resources/01-data-generator.py``), ``forecast``
+the scaled fit-tune-score notebook
+(``group_apply/02_Fine_Grained_Demand_Forecasting.py:341-556``),
+``train`` the distributed-training driver
+(``deep_learning/2.distributed-data-loading-petastorm.py:342-470``), and
+``hpo`` the data-size playbook (``hyperopt/2. hyperopt on diff sizes of
+data.py``). The ``pipeline`` subcommand (the RUNME job-DAG equivalent)
+lives in :mod:`.pipeline`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+# --------------------------------------------------------------------------
+# datagen
+# --------------------------------------------------------------------------
+
+def register_datagen(sub: argparse._SubParsersAction) -> None:
+    gen = sub.add_parser(
+        "datagen", help="synthetic data generators (demand / bom / regression)"
+    )
+    gsub = gen.add_subparsers(dest="generator", required=True)
+
+    demand = gsub.add_parser("demand", help="ARMA weekly demand panel → Delta")
+    demand.add_argument("--out", required=True, help="Delta table path")
+    demand.add_argument("--skus-per-product", type=int, default=10)
+    demand.add_argument("--years", type=int, default=3)
+    demand.add_argument("--seed", type=int, default=123)
+    demand.set_defaults(fn=_cmd_datagen_demand)
+
+    bom = gsub.add_parser("bom", help="random 3-level BoM DAG per SKU → Delta")
+    bom.add_argument(
+        "--demand", required=True, help="demand Delta table to take SKUs from"
+    )
+    bom.add_argument("--out", required=True, help="bom Delta table path")
+    bom.add_argument("--mapper-out", required=True, help="sku_mapper Delta path")
+    bom.add_argument("--depth", type=int, default=3)
+    bom.add_argument("--seed", type=int, default=123)
+    bom.set_defaults(fn=_cmd_datagen_bom)
+
+    reg = gsub.add_parser(
+        "regression", help="byte-targeted synthetic regression → npz"
+    )
+    reg.add_argument("--bytes", type=float, required=True, dest="n_bytes")
+    reg.add_argument("--out", required=True, help="output .npz path")
+    reg.set_defaults(fn=_cmd_datagen_regression)
+
+
+def _cmd_datagen_demand(args: argparse.Namespace) -> int:
+    from ..datagen.demand import DemandConfig, generate_demand, write_demand_delta
+
+    cfg = DemandConfig(
+        n_skus_per_product=args.skus_per_product,
+        ts_length_years=args.years,
+        seed=args.seed,
+    )
+    df = generate_demand(cfg)
+    write_demand_delta(df, args.out)
+    print(
+        f"demand: {df['SKU'].nunique()} SKUs × "
+        f"{df['Date'].nunique()} weeks = {len(df)} rows -> {args.out}"
+    )
+    return 0
+
+
+def _cmd_datagen_bom(args: argparse.Namespace) -> int:
+    from ..datagen.bom import generate_bom, write_bom_delta
+
+    skus = sorted(set(_read_delta_pandas(args.demand, columns=["SKU"])["SKU"]))
+    tables = generate_bom(skus, depth=args.depth, seed=args.seed)
+    write_bom_delta(tables, args.out, args.mapper_out)
+    print(
+        f"bom: {len(tables.bom)} edges, {len(tables.sku_mapper)} sku mappings "
+        f"-> {args.out}, {args.mapper_out}"
+    )
+    return 0
+
+
+def _cmd_datagen_regression(args: argparse.Namespace) -> int:
+    from ..datagen.regression import gen_data
+    from ..hpo.shipping import save_shared
+
+    X_train, X_test, y_train, y_test = gen_data(int(args.n_bytes))
+    path = save_shared(
+        args.out, X_train=X_train, X_test=X_test, y_train=y_train, y_test=y_test
+    )
+    print(f"regression: {len(X_train)}+{len(X_test)} samples -> {path}")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# forecast
+# --------------------------------------------------------------------------
+
+def register_forecast(sub: argparse._SubParsersAction) -> None:
+    fc = sub.add_parser(
+        "forecast", help="per-SKU SARIMAX tune + fit + score over a demand table"
+    )
+    fc.add_argument("--data", required=True, help="demand Delta table")
+    fc.add_argument("--out", required=True, help="forecast Delta table to write")
+    fc.add_argument("--max-evals", type=int, default=10)
+    fc.add_argument("--horizon", type=int, default=40, help="holdout weeks")
+    fc.add_argument("--rstate", type=int, default=123)
+    fc.add_argument(
+        "--no-mesh", action="store_true",
+        help="keep the group axis on one device (debug)",
+    )
+    fc.add_argument("--experiment", default="forecasting")
+    fc.add_argument("--tracking-root", default=None)
+    fc.add_argument("--max-p", type=int, default=4, help="AR order bound")
+    fc.add_argument("--max-d", type=int, default=2, help="differencing bound")
+    fc.add_argument("--max-q", type=int, default=4, help="MA order bound")
+    fc.add_argument("--max-iter", type=int, default=200, help="Nelder-Mead iters")
+    fc.set_defaults(fn=_cmd_forecast)
+
+
+def _cmd_forecast(args: argparse.Namespace) -> int:
+    import pyarrow as pa
+
+    from ..data.delta import write_delta
+    from ..ops import SarimaxConfig
+    from ..runtime import make_mesh
+    from ..workloads.forecasting import (
+        EXO_FIELDS,
+        add_exo_variables,
+        tune_and_forecast_panel,
+    )
+
+    t0 = time.perf_counter()
+    df = _read_delta_pandas(args.data)
+    enriched = add_exo_variables(df)
+    mesh = None if args.no_mesh else make_mesh()
+    cfg = SarimaxConfig(
+        max_p=args.max_p, max_d=args.max_d, max_q=args.max_q,
+        k_exog=len(EXO_FIELDS), max_iter=args.max_iter,
+    )
+    out = tune_and_forecast_panel(
+        enriched,
+        max_evals=args.max_evals,
+        forecast_horizon=args.horizon,
+        rstate=args.rstate,
+        mesh=mesh,
+        cfg=cfg,
+    )
+    write_delta(
+        pa.Table.from_pandas(out, preserve_index=False), args.out, mode="overwrite"
+    )
+    dt = time.perf_counter() - t0
+    err = out["Demand"] - out["Demand_Fitted"]
+    mse = float((err**2).mean())
+    groups = out.groupby(["Product", "SKU"]).ngroups
+    if args.tracking_root:
+        from ..tracking import RunStore
+
+        store = RunStore(args.tracking_root, args.experiment, run_name="forecast")
+        store.log_params(
+            {"max_evals": args.max_evals, "horizon": args.horizon, "groups": groups}
+        )
+        store.log_metrics({"mse": mse, "wall_s": dt}, step=0)
+        store.finish()
+    print(
+        f"forecast: {groups} groups, {len(out)} rows, mse {mse:.2f}, "
+        f"{dt:.1f}s -> {args.out}"
+    )
+    return 0
+
+
+# --------------------------------------------------------------------------
+# train
+# --------------------------------------------------------------------------
+
+def register_train(sub: argparse._SubParsersAction) -> None:
+    tr = sub.add_parser(
+        "train", help="data-parallel image-classifier training from a Delta table"
+    )
+    tr.add_argument("--data", required=True, help="train Delta table (content/label_index)")
+    tr.add_argument("--val-data", default=None, help="validation Delta table")
+    tr.add_argument("--epochs", type=int, default=2)
+    tr.add_argument("--batch-size", type=int, default=212)
+    tr.add_argument("--learning-rate", type=float, default=1e-5)
+    tr.add_argument("--num-classes", type=int, default=1000)
+    tr.add_argument("--crop", type=int, default=224)
+    tr.add_argument("--model", choices=["resnet50", "tiny"], default="resnet50")
+    tr.add_argument("--workers", type=int, default=2)
+    tr.add_argument("--queue-size", type=int, default=20)
+    tr.add_argument("--limit-val-batches", type=int, default=5)
+    tr.add_argument("--checkpoint-dir", default=None)
+    tr.add_argument("--resume", action="store_true")
+    tr.add_argument("--profile-dir", default=None)
+    tr.add_argument("--experiment", default="imagenet")
+    tr.add_argument("--tracking-root", default=None)
+    tr.add_argument(
+        "--coordinator", default=None,
+        help="host:port for multi-host rendezvous (process 0)",
+    )
+    tr.set_defaults(fn=_cmd_train)
+
+
+def _cmd_train(args: argparse.Namespace) -> int:
+    import optax
+
+    from ..data import DeltaTable, batch_loader
+    from ..data.transform import imagenet_transform_spec
+    from ..models import ResNet50
+    from ..parallel import ClassifierTask, Trainer, TrainerConfig
+    from ..runtime import initialize_distributed, local_topology, make_mesh
+
+    initialize_distributed(coordinator_address=args.coordinator)
+    # Each process reads a disjoint shard (the reference's
+    # cur_shard=rank / shard_count=WORLD, 2...py:249-250); the mesh
+    # assembles per-process rows into the global batch.
+    topo = local_topology()
+
+    table = DeltaTable(args.data)
+    rows = table.num_records()
+    spec = imagenet_transform_spec(crop=args.crop)
+    if args.model == "resnet50":
+        model = ResNet50(num_classes=args.num_classes)
+    else:
+        from ..models.resnet import ResNet, ResNetBlock
+
+        model = ResNet(
+            stage_sizes=[1, 1], block_cls=ResNetBlock,
+            num_classes=args.num_classes, num_filters=8,
+        )
+    task = ClassifierTask(model=model, tx=optax.adam(args.learning_rate))
+
+    tracker = None
+    if args.tracking_root:
+        from ..tracking import RunStore
+
+        tracker = RunStore(args.tracking_root, args.experiment, run_name="train")
+        tracker.log_params(
+            {k: v for k, v in vars(args).items() if k != "fn" and v is not None}
+        )
+
+    trainer = Trainer(
+        TrainerConfig(
+            max_epochs=args.epochs,
+            total_train_rows=rows,
+            limit_val_batches=args.limit_val_batches,
+            checkpoint_dir=args.checkpoint_dir,
+            resume=args.resume,
+            profile_dir=args.profile_dir,
+        ),
+        mesh=make_mesh(),
+        tracker=tracker,
+    )
+
+    val_factory = None
+    if args.val_data:
+        val_table = DeltaTable(args.val_data)
+
+        def val_factory():
+            return batch_loader(
+                val_table, batch_size=args.batch_size, num_epochs=1,
+                transform_spec=spec, shuffle_row_groups=False,
+                cur_shard=topo.process_index, shard_count=topo.process_count,
+            ).__enter__()
+
+    with batch_loader(
+        table,
+        batch_size=args.batch_size,
+        num_epochs=None,
+        workers_count=args.workers,
+        results_queue_size=args.queue_size,
+        transform_spec=spec,
+        cur_shard=topo.process_index,
+        shard_count=topo.process_count,
+    ) as train_reader:
+        result = trainer.fit(task, train_reader, val_data_factory=val_factory)
+
+    last = result.history[-1] if result.history else {}
+    if tracker is not None:
+        tracker.finish()
+    print(
+        json.dumps(
+            {
+                "steps": int(result.state.step),
+                "epochs": len(result.history),
+                "images_per_sec": round(last.get("images_per_sec", 0.0), 2),
+                "train_loss": last.get("train_loss"),
+                "val_acc": last.get("val_acc"),
+                "best_checkpoint": result.best_checkpoint_path,
+            }
+        )
+    )
+    return 0
+
+
+# --------------------------------------------------------------------------
+# hpo (the data-size playbook demo)
+# --------------------------------------------------------------------------
+
+def register_hpo(sub: argparse._SubParsersAction) -> None:
+    hp_ = sub.add_parser(
+        "hpo", help="distributed TPE sweep over a Lasso objective (size playbook)"
+    )
+    hp_.add_argument(
+        "--data", default=None,
+        help=".npz from `datagen regression` (shared-FS shipping); "
+        "omit to generate in-process (closure shipping)",
+    )
+    hp_.add_argument("--bytes", type=float, default=1e6, dest="n_bytes")
+    hp_.add_argument("--parallelism", type=int, default=2)
+    hp_.add_argument("--max-evals", type=int, default=4)
+    hp_.set_defaults(fn=_cmd_hpo)
+
+
+def _cmd_hpo(args: argparse.Namespace) -> int:
+    from ..datagen.regression import gen_data, train_and_eval, tune_alpha
+    from ..hpo.shipping import load_shared
+
+    if args.data:
+        arrays = load_shared(args.data)
+        data = (
+            arrays["X_train"], arrays["X_test"],
+            arrays["y_train"], arrays["y_test"],
+        )
+        mode = "shared-fs"
+    else:
+        data = gen_data(int(args.n_bytes))
+        mode = "closure"
+
+    def objective(alpha):
+        return train_and_eval(data, alpha)
+
+    best = tune_alpha(
+        objective, parallelism=args.parallelism, max_evals=args.max_evals
+    )
+    print(f"hpo ({mode}): best alpha {best:.4f}")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# shared helpers
+# --------------------------------------------------------------------------
+
+def _read_delta_pandas(path: str, columns: list[str] | None = None):
+    """Whole-table read through the Delta log (no Spark; reference reads
+    the same tables with ``spark.read.format("delta")``)."""
+    import pyarrow.parquet as pq
+
+    from ..data.delta import DeltaTable
+
+    table = DeltaTable(path)
+    import pyarrow as pa
+
+    parts = [pq.read_table(uri, columns=columns) for uri in table.file_uris()]
+    return pa.concat_tables(parts).to_pandas()
+
+
+def register_all(sub: argparse._SubParsersAction) -> None:
+    register_datagen(sub)
+    register_forecast(sub)
+    register_train(sub)
+    register_hpo(sub)
+    from .pipeline import register_pipeline
+
+    register_pipeline(sub)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    from .cli import main
+
+    sys.exit(main())

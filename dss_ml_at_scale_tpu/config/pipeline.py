@@ -1,0 +1,136 @@
+"""Job-DAG pipeline runner (the RUNME deployment equivalent).
+
+The reference deploys a Databricks Workflows job from a literal JSON spec
+— a task DAG with ``task_key``/``depends_on``, per-job ``timeout_seconds``
+and ``max_concurrent_runs: 1`` (``group_apply/RUNME.py:35-106``) — and
+treats that job running green as its integration test (SURVEY.md §4.1).
+Here the same shape is a plain JSON file whose tasks are `dsst`
+subcommand argv lists, executed in dependency order as subprocesses (one
+fresh process per task, like one cluster per notebook task), each under
+its own timeout (the reference's child-notebook timeout,
+``00-setup.py:59``).
+
+Spec format::
+
+    {
+      "name": "demand-forecasting",
+      "timeout_seconds": 600,            # default per-task ceiling
+      "tasks": [
+        {"task_key": "gen",
+         "argv": ["datagen", "demand", "--out", "{workdir}/demand"]},
+        {"task_key": "forecast",
+         "argv": ["forecast", "--data", "{workdir}/demand",
+                  "--out", "{workdir}/forecast"],
+         "depends_on": ["gen"],
+         "timeout_seconds": 1200}
+      ]
+    }
+
+``{workdir}`` in any argv element is substituted from ``--workdir``.
+Tasks run sequentially in topological order (``max_concurrent_runs: 1``
+semantics); a failed or timed-out task skips its dependents and fails
+the run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+
+def register_pipeline(sub: argparse._SubParsersAction) -> None:
+    pl = sub.add_parser("pipeline", help="run a task-DAG of dsst subcommands")
+    pl.add_argument("--spec", required=True, help="pipeline JSON file")
+    pl.add_argument("--workdir", default=".", help="substituted for {workdir}")
+    pl.add_argument(
+        "--dry-run", action="store_true", help="print the execution plan only"
+    )
+    pl.set_defaults(fn=run_pipeline)
+
+
+def _topo_order(tasks: list[dict]) -> list[dict]:
+    by_key = {t["task_key"]: t for t in tasks}
+    if len(by_key) != len(tasks):
+        raise ValueError("duplicate task_key in pipeline spec")
+    for t in tasks:
+        for dep in t.get("depends_on", []):
+            if dep not in by_key:
+                raise ValueError(
+                    f"task {t['task_key']!r} depends on unknown task {dep!r}"
+                )
+    order: list[dict] = []
+    done: set[str] = set()
+    remaining = list(tasks)  # spec order is the tiebreak (stable)
+    while remaining:
+        ready = [
+            t for t in remaining if all(d in done for d in t.get("depends_on", []))
+        ]
+        if not ready:
+            cycle = ", ".join(t["task_key"] for t in remaining)
+            raise ValueError(f"dependency cycle among tasks: {cycle}")
+        for t in ready:
+            order.append(t)
+            done.add(t["task_key"])
+        remaining = [t for t in remaining if t["task_key"] not in done]
+    return order
+
+
+def run_pipeline(args: argparse.Namespace) -> int:
+    spec = json.loads(Path(args.spec).read_text())
+    default_timeout = spec.get("timeout_seconds", 28800)  # RUNME.py:36
+    order = _topo_order(spec.get("tasks", []))
+    workdir = str(Path(args.workdir).absolute())
+
+    def render(argv: list[str]) -> list[str]:
+        return [a.replace("{workdir}", workdir) for a in argv]
+
+    if args.dry_run:
+        for t in order:
+            deps = ",".join(t.get("depends_on", [])) or "-"
+            print(f"{t['task_key']:<20} after [{deps}]  dsst {' '.join(render(t['argv']))}")
+        return 0
+
+    print(f"pipeline {spec.get('name', Path(args.spec).stem)}: {len(order)} tasks")
+    failed: set[str] = set()
+    skipped: set[str] = set()
+    for t in order:
+        key = t["task_key"]
+        blocked = [
+            d for d in t.get("depends_on", []) if d in failed or d in skipped
+        ]
+        if blocked:
+            print(f"[{key}] SKIPPED (failed dependency {', '.join(blocked)})")
+            skipped.add(key)
+            continue
+        argv = render(t["argv"])
+        timeout = t.get("timeout_seconds", default_timeout)
+        print(f"[{key}] dsst {' '.join(argv)}")
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "dss_ml_at_scale_tpu.config.cli", *argv],
+                timeout=timeout,
+            )
+            code = proc.returncode
+        except subprocess.TimeoutExpired:
+            print(f"[{key}] TIMEOUT after {timeout}s")
+            failed.add(key)
+            continue
+        dt = time.perf_counter() - t0
+        if code != 0:
+            print(f"[{key}] FAILED (exit {code}, {dt:.1f}s)")
+            failed.add(key)
+        else:
+            print(f"[{key}] ok ({dt:.1f}s)")
+    if failed:
+        skipped_note = (
+            f" (skipped: {', '.join(sorted(skipped))})" if skipped else ""
+        )
+        print(f"pipeline failed: {', '.join(sorted(failed))}{skipped_note}")
+        return 1
+    print("pipeline ok")
+    return 0

@@ -60,12 +60,13 @@ def weekly_date_spine(cfg: DemandConfig = DemandConfig()) -> pd.DataFrame:
     df = pd.DataFrame({"Date": dates})
 
     # COVID helper: 0 before the breakpoint, then 0,1,2,... counting up
-    # (the reference's help_list construction, ``:149-155``).
-    after = np.flatnonzero(dates >= pd.Timestamp(cfg.corona_breakpoint))
-    helper = np.zeros(len(dates), int)
-    if len(after):
-        b = after[0]
-        helper[b - 1 :] = np.arange(len(dates) - b + 1)
+    # (the reference's help_list construction, ``:149-155``). Computed in
+    # closed form from the breakpoint's (possibly out-of-range) week index
+    # so short spines starting after the breakpoint continue the ramp
+    # instead of wrapping a negative slice.
+    delta_days = (pd.Timestamp(cfg.corona_breakpoint) - dates[0]).days
+    b = -(-delta_days // 7)  # ceil; index of first spine Monday >= breakpoint
+    helper = np.maximum(0, np.arange(len(dates)) - b + 1)
     df["Corona_Breakpoint_Helper"] = helper
 
     span = max(helper.max(), 1)

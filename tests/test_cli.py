@@ -1,0 +1,200 @@
+"""CLI subcommands + pipeline DAG runner (the RUNME-equivalent surface)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dss_ml_at_scale_tpu.config.cli import build_parser, main
+from dss_ml_at_scale_tpu.config.pipeline import _topo_order
+
+
+def test_parser_registers_all_subcommands():
+    parser = build_parser()
+    text = parser.format_help()
+    for cmd in ("info", "datagen", "forecast", "train", "hpo", "pipeline"):
+        assert cmd in text
+
+
+def test_datagen_demand_and_bom(tmp_path, capsys):
+    demand = tmp_path / "demand"
+    assert main([
+        "datagen", "demand", "--out", str(demand),
+        "--skus-per-product", "1", "--years", "1",
+    ]) == 0
+    assert (demand / "_delta_log").is_dir()
+    assert main([
+        "datagen", "bom", "--demand", str(demand),
+        "--out", str(tmp_path / "bom"),
+        "--mapper-out", str(tmp_path / "mapper"),
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "5 SKUs" in out  # 5 products × 1 SKU
+    assert "sku mappings" in out
+
+
+def test_datagen_regression_and_hpo_shared_fs(tmp_path, capsys):
+    npz = tmp_path / "reg.npz"
+    assert main([
+        "datagen", "regression", "--bytes", "200000", "--out", str(npz),
+    ]) == 0
+    assert npz.exists()
+    assert main([
+        "hpo", "--data", str(npz), "--parallelism", "2", "--max-evals", "2",
+    ]) == 0
+    assert "shared-fs" in capsys.readouterr().out
+
+
+def test_hpo_closure_mode(capsys):
+    assert main(["hpo", "--bytes", "100000", "--max-evals", "2"]) == 0
+    assert "closure" in capsys.readouterr().out
+
+
+def test_forecast_end_to_end(tmp_path, capsys, devices8):
+    demand = tmp_path / "demand"
+    main([
+        "datagen", "demand", "--out", str(demand),
+        "--skus-per-product", "1", "--years", "1",
+    ])
+    out_table = tmp_path / "forecast"
+    assert main([
+        "forecast", "--data", str(demand), "--out", str(out_table),
+        "--max-evals", "2", "--horizon", "12",
+        "--max-p", "2", "--max-d", "1", "--max-q", "2", "--max-iter", "40",
+        "--tracking-root", str(tmp_path / "runs"),
+    ]) == 0
+    assert (out_table / "_delta_log").is_dir()
+    # Forecast rows match input rows; tracking run landed.
+    from dss_ml_at_scale_tpu.config.commands import _read_delta_pandas
+
+    fc = _read_delta_pandas(out_table)
+    assert set(fc.columns) == {"Product", "SKU", "Date", "Demand", "Demand_Fitted"}
+    assert np.isfinite(fc["Demand_Fitted"]).all()
+    assert list((tmp_path / "runs" / "forecasting").iterdir())
+    assert "groups" in capsys.readouterr().out
+
+
+def test_train_cli_tiny(tmp_path, capsys, devices8):
+    # Reuse the end-to-end fixture recipe: tiny JPEG Delta table.
+    from test_end_to_end import _jpeg
+    import pyarrow as pa
+
+    from dss_ml_at_scale_tpu.data import write_delta
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 64)
+    table = pa.table({
+        "content": pa.array([_jpeg(rng, l) for l in labels], type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    data = tmp_path / "images"
+    write_delta(table, data, max_rows_per_file=16)
+
+    assert main([
+        "train", "--data", str(data), "--model", "tiny",
+        "--num-classes", "4", "--crop", "64", "--batch-size", "16",
+        "--epochs", "1", "--learning-rate", "0.01",
+    ]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["steps"] == 4  # 64 rows // 16
+    assert summary["images_per_sec"] > 0
+
+
+def test_topo_order_and_cycles():
+    tasks = [
+        {"task_key": "c", "argv": [], "depends_on": ["a", "b"]},
+        {"task_key": "a", "argv": []},
+        {"task_key": "b", "argv": [], "depends_on": ["a"]},
+    ]
+    assert [t["task_key"] for t in _topo_order(tasks)] == ["a", "b", "c"]
+    with pytest.raises(ValueError, match="cycle"):
+        _topo_order([
+            {"task_key": "x", "argv": [], "depends_on": ["y"]},
+            {"task_key": "y", "argv": [], "depends_on": ["x"]},
+        ])
+    with pytest.raises(ValueError, match="unknown"):
+        _topo_order([{"task_key": "x", "argv": [], "depends_on": ["nope"]}])
+
+
+def test_pipeline_dry_run(tmp_path, capsys):
+    spec = {
+        "name": "t",
+        "tasks": [
+            {"task_key": "gen", "argv": ["datagen", "demand", "--out", "{workdir}/d"]},
+            {"task_key": "next", "argv": ["info"], "depends_on": ["gen"]},
+        ],
+    }
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(spec))
+    assert main([
+        "pipeline", "--spec", str(spec_path), "--workdir", str(tmp_path),
+        "--dry-run",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert str(tmp_path / "d") in out
+    assert out.index("gen") < out.index("next")
+
+
+def test_pipeline_runs_tasks_and_skips_dependents_on_failure(tmp_path, capsys):
+    # Real subprocess execution: jax-free tasks only (datagen).
+    spec = {
+        "name": "t",
+        "timeout_seconds": 120,
+        "tasks": [
+            {"task_key": "gen",
+             "argv": ["datagen", "demand", "--out", "{workdir}/demand",
+                      "--skus-per-product", "1", "--years", "1"]},
+            {"task_key": "bad",
+             "argv": ["datagen", "bom", "--demand", "{workdir}/missing",
+                      "--out", "{workdir}/bom", "--mapper-out", "{workdir}/m"],
+             "depends_on": ["gen"]},
+            {"task_key": "downstream",
+             "argv": ["datagen", "regression", "--bytes", "1e5",
+                      "--out", "{workdir}/r.npz"],
+             "depends_on": ["bad"]},
+        ],
+    }
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(spec))
+    assert main([
+        "pipeline", "--spec", str(spec_path), "--workdir", str(tmp_path),
+    ]) == 1
+    out = capsys.readouterr().out
+    assert (tmp_path / "demand" / "_delta_log").is_dir()
+    assert "[bad] FAILED" in out
+    assert "[downstream] SKIPPED" in out
+    assert not (tmp_path / "r.npz").exists()
+
+
+def test_example_pipeline_spec_is_valid():
+    import pathlib
+
+    spec = json.loads(
+        (pathlib.Path(__file__).parent.parent / "pipelines"
+         / "demand_forecasting.json").read_text()
+    )
+    order = [t["task_key"] for t in _topo_order(spec["tasks"])]
+    assert order[0] == "generate_demand"
+    assert set(order) == {
+        "generate_demand", "generate_bom", "fine_grained_forecasting",
+    }
+
+
+def test_pipeline_summary_separates_failed_from_skipped(tmp_path, capsys):
+    spec = {
+        "tasks": [
+            {"task_key": "bad",
+             "argv": ["datagen", "bom", "--demand", "{workdir}/missing",
+                      "--out", "{workdir}/b", "--mapper-out", "{workdir}/m"]},
+            {"task_key": "down", "argv": ["info"], "depends_on": ["bad"]},
+        ],
+    }
+    spec_path = tmp_path / "s.json"
+    spec_path.write_text(json.dumps(spec))
+    assert main([
+        "pipeline", "--spec", str(spec_path), "--workdir", str(tmp_path),
+    ]) == 1
+    out = capsys.readouterr().out
+    assert "pipeline failed: bad (skipped: down)" in out
