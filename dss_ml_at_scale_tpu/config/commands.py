@@ -56,6 +56,16 @@ def register_datagen(sub: argparse._SubParsersAction) -> None:
 
 
 def _cmd_datagen_demand(args: argparse.Namespace) -> int:
+    # The ARMA sampler runs through JAX; for a datagen-sized workload the
+    # host CPU is the right backend — don't claim (or wait on) an
+    # accelerator from a data-prep subprocess.
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized by the calling process
+
     from ..datagen.demand import DemandConfig, generate_demand, write_demand_delta
 
     cfg = DemandConfig(
@@ -171,6 +181,82 @@ def _cmd_forecast(args: argparse.Namespace) -> int:
         f"forecast: {groups} groups, {len(out)} rows, mse {mse:.2f}, "
         f"{dt:.1f}s -> {args.out}"
     )
+    return 0
+
+
+# --------------------------------------------------------------------------
+# eda (single-SKU model selection)
+# --------------------------------------------------------------------------
+
+def register_eda(sub: argparse._SubParsersAction) -> None:
+    eda = sub.add_parser(
+        "eda", help="single-SKU model comparison: Holt-Winters vs SARIMAX vs tuned"
+    )
+    eda.add_argument("--data", required=True, help="demand Delta table")
+    eda.add_argument("--product", default=None)
+    eda.add_argument("--sku", default=None, help="defaults to the first SKU")
+    eda.add_argument("--horizon", type=int, default=40)
+    eda.add_argument("--seasonal-periods", type=int, default=52)
+    eda.add_argument("--max-evals", type=int, default=10)
+    eda.add_argument("--parallelism", type=int, default=10)
+    eda.add_argument("--max-iter", type=int, default=200)
+    eda.set_defaults(fn=_cmd_eda)
+
+
+def _cmd_eda(args: argparse.Namespace) -> int:
+    from ..ops import SarimaxConfig
+    from ..workloads.eda import run_eda
+    from ..workloads.forecasting import EXO_FIELDS
+
+    df = _read_delta_pandas(args.data)
+    report = run_eda(
+        df,
+        product=args.product,
+        sku=args.sku,
+        horizon=args.horizon,
+        seasonal_periods=args.seasonal_periods,
+        max_evals=args.max_evals,
+        parallelism=args.parallelism,
+        cfg=SarimaxConfig(k_exog=len(EXO_FIELDS), max_iter=args.max_iter),
+    )
+    print(f"EDA for Product={report.product} SKU={report.sku} "
+          f"(holdout {args.horizon} weeks)")
+    print(report.scores.to_string(index=False))
+    print(f"best SARIMAX order: {report.best_order} (mse {report.best_order_mse:.2f})")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# ingest
+# --------------------------------------------------------------------------
+
+def register_ingest(sub: argparse._SubParsersAction) -> None:
+    ing = sub.add_parser(
+        "ingest", help="image dataset directory → Delta table with stable ids"
+    )
+    ing.add_argument("--data-root", required=True)
+    ing.add_argument("--out", required=True, help="Delta table path")
+    ing.add_argument("--pattern", default="*.JPEG")
+    ing.add_argument(
+        "--label-from", choices=["path", "annotation"], default="path"
+    )
+    ing.add_argument("--rows-per-fragment", type=int, default=1024)
+    ing.add_argument("--append", action="store_true")
+    ing.set_defaults(fn=_cmd_ingest)
+
+
+def _cmd_ingest(args: argparse.Namespace) -> int:
+    from ..ingest import ingest_image_dataset
+
+    table = ingest_image_dataset(
+        args.data_root,
+        args.out,
+        file_pattern=args.pattern,
+        label_from=args.label_from,
+        rows_per_fragment=args.rows_per_fragment,
+        mode="append" if args.append else "overwrite",
+    )
+    print(f"ingested {table.num_records()} rows -> {args.out}")
     return 0
 
 
@@ -362,6 +448,8 @@ def _read_delta_pandas(path: str, columns: list[str] | None = None):
 def register_all(sub: argparse._SubParsersAction) -> None:
     register_datagen(sub)
     register_forecast(sub)
+    register_eda(sub)
+    register_ingest(sub)
     register_train(sub)
     register_hpo(sub)
     from .pipeline import register_pipeline

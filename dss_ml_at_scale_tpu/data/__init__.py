@@ -12,4 +12,14 @@ from .delta import DeltaTable, write_delta  # noqa: F401
 from .reader import ParquetShardReader, batch_loader, make_batch_reader  # noqa: F401
 from .sharding import RowGroupUnit, list_row_groups, shard_units  # noqa: F401
 from .transform import TransformSpec  # noqa: F401
-from .prefetch import prefetch_to_mesh  # noqa: F401
+
+
+def __getattr__(name):
+    # prefetch imports jax, which initializes the accelerator backend on
+    # import; loaded lazily so jax-free paths (datagen subprocesses, pure
+    # Delta IO) never touch the device runtime.
+    if name == "prefetch_to_mesh":
+        from .prefetch import prefetch_to_mesh
+
+        return prefetch_to_mesh
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -5,6 +5,7 @@ the TPU-native substrate: ``forecasting`` is the per-SKU fit-tune-score
 pipeline of ``group_apply/02_Fine_Grained_Demand_Forecasting.py``.
 """
 
+from .eda import EdaReport, extract_sku_series, run_eda  # noqa: F401
 from .forecasting import (
     EXO_FIELDS,
     SEARCH_SPACE,
@@ -15,6 +16,9 @@ from .forecasting import (
 )
 
 __all__ = [
+    "EdaReport",
+    "extract_sku_series",
+    "run_eda",
     "EXO_FIELDS",
     "SEARCH_SPACE",
     "add_exo_variables",

@@ -1,0 +1,171 @@
+"""Single-SKU EDA / model selection (the reference's exploration notebook).
+
+TPU-native rebuild of ``group_apply/02_Fine_Grained_Demand_Forecasting.py:
+60-324`` (R11 in SURVEY.md §2.1): extract one SKU's series, hold out the
+last ``horizon`` weeks, then compare
+
+- four Holt-Winters variants — {additive, multiplicative} seasonal ×
+  {damped, undamped}, Box-Cox on (``:143-188``),
+- SARIMAX with and without exogenous regressors (``:226-245``),
+- a TPE search over SARIMAX ``(p, d, q)`` run on the parallel trials
+  executor (``SparkTrials(parallelism=10)`` + seeded rstate,
+  ``:264-315``),
+
+all scored by holdout MSE. Returns a tidy report frame (the notebook's
+plots + displayed tables condensed to data).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pandas as pd
+
+from ..hpo import fmin, hp
+from ..hpo.hp import scope
+from ..ops import (
+    SarimaxConfig,
+    holt_winters_fit,
+    holt_winters_forecast,
+    sarimax_fit,
+    sarimax_predict,
+)
+from .forecasting import EXO_FIELDS, add_exo_variables
+
+HW_VARIANTS = {
+    "hw_add": dict(seasonal="add", damped=False),
+    "hw_add_damped": dict(seasonal="add", damped=True),
+    "hw_mul": dict(seasonal="mul", damped=False),
+    "hw_mul_damped": dict(seasonal="mul", damped=True),
+}
+
+
+@dataclasses.dataclass
+class EdaReport:
+    """Model-comparison results for one SKU."""
+
+    product: str
+    sku: str
+    scores: pd.DataFrame          # columns: model, mse
+    best_order: tuple[int, int, int]
+    best_order_mse: float
+
+    def to_frame(self) -> pd.DataFrame:
+        out = self.scores.copy()
+        out.insert(0, "SKU", self.sku)
+        out.insert(0, "Product", self.product)
+        return out
+
+
+def extract_sku_series(
+    df: pd.DataFrame, product: str | None = None, sku: str | None = None
+) -> pd.DataFrame:
+    """One SKU's weekly series, date-sorted (reference ``:79-87``).
+
+    Defaults to the first (Product, SKU) pair when not specified — the
+    notebook hand-picks one; any works for model selection.
+    """
+    if sku is None:
+        pool = df if product is None else df[df["Product"] == product]
+        if pool.empty:
+            raise ValueError(f"no rows for Product={product!r}")
+        first = pool[["Product", "SKU"]].drop_duplicates().iloc[0]
+        product, sku = first["Product"], first["SKU"]
+    sel = df[df["SKU"] == sku]
+    if product is not None:
+        sel = sel[sel["Product"] == product]
+    if sel.empty:
+        raise ValueError(f"no rows for Product={product!r} SKU={sku!r}")
+    return sel.sort_values("Date").reset_index(drop=True)
+
+
+def _holdout_mse(pred: np.ndarray, actual: np.ndarray) -> float:
+    return float(np.mean((np.asarray(pred) - np.asarray(actual)) ** 2))
+
+
+def run_eda(
+    df: pd.DataFrame,
+    product: str | None = None,
+    sku: str | None = None,
+    *,
+    horizon: int = 40,
+    seasonal_periods: int = 52,
+    sarimax_order: tuple[int, int, int] = (1, 0, 1),
+    max_evals: int = 10,
+    parallelism: int = 10,
+    rstate: int = 123,
+    cfg: SarimaxConfig | None = None,
+) -> EdaReport:
+    """Fit every candidate model on one SKU and score the holdout window."""
+    from ..parallel.trials import DeviceTrials
+
+    series = extract_sku_series(df, product, sku)
+    if "covid" not in series.columns:
+        series = add_exo_variables(series)
+    if len(series) <= horizon:
+        raise ValueError(
+            f"series has {len(series)} points, holdout of {horizon} leaves no train"
+        )
+    y = series["Demand"].to_numpy(np.float32)
+    exog = series[EXO_FIELDS].to_numpy(np.float32)
+    n = len(y)
+    n_train = n - horizon
+    y_train, y_score = y[:n_train], y[n_train:]
+
+    rows: list[dict] = []
+
+    # -- Holt-Winters variants (Box-Cox on, as in the notebook) ----------
+    for name, kw in HW_VARIANTS.items():
+        try:
+            fit = holt_winters_fit(
+                y_train, seasonal_periods, use_boxcox=True, **kw
+            )
+            fc = np.asarray(holt_winters_forecast(fit, horizon))
+            rows.append({"model": name, "mse": _holdout_mse(fc, y_score)})
+        except ValueError as e:  # too short for 2 seasons
+            rows.append({"model": name, "mse": float("nan"), "note": str(e)})
+
+    # -- SARIMAX with / without exog -------------------------------------
+    cfg = cfg or SarimaxConfig(k_exog=len(EXO_FIELDS))
+    order = np.asarray(sarimax_order, np.int32)
+
+    def sarimax_mse(use_exog: bool) -> float:
+        ex = exog if use_exog else np.zeros_like(exog)
+        fit = sarimax_fit(cfg, y, ex, order, n_train)
+        pred = np.asarray(sarimax_predict(cfg, fit.params, y, ex, order, n_train))
+        return _holdout_mse(pred[n_train:], y_score)
+
+    rows.append({"model": "sarimax_exog", "mse": sarimax_mse(True)})
+    rows.append({"model": "sarimax_no_exog", "mse": sarimax_mse(False)})
+
+    # -- TPE over (p, d, q) on the parallel executor ---------------------
+    space = {
+        "p": scope.int(hp.quniform("p", 0, cfg.max_p, 1)),
+        "d": scope.int(hp.quniform("d", 0, cfg.max_d, 1)),
+        "q": scope.int(hp.quniform("q", 0, cfg.max_q, 1)),
+    }
+
+    def objective(point):
+        o = np.asarray([point["p"], point["d"], point["q"]], np.int32)
+        fit = sarimax_fit(cfg, y, exog, o, n_train)
+        pred = np.asarray(sarimax_predict(cfg, fit.params, y, exog, o, n_train))
+        return {"loss": _holdout_mse(pred[n_train:], y_score), "status": "ok"}
+
+    trials = DeviceTrials(parallelism=parallelism, pin_devices=False)
+    best = fmin(
+        objective, space, max_evals=max_evals, trials=trials,
+        rstate=np.random.default_rng(rstate),
+    )
+    best_order = (int(best["p"]), int(best["d"]), int(best["q"]))
+    best_mse = float(trials.best_trial["result"]["loss"])
+    rows.append({"model": f"sarimax_tuned{best_order}", "mse": best_mse})
+
+    scores = pd.DataFrame(rows).sort_values("mse").reset_index(drop=True)
+    return EdaReport(
+        product=str(series["Product"].iloc[0]),
+        sku=str(series["SKU"].iloc[0]),
+        scores=scores,
+        best_order=best_order,
+        best_order_mse=best_mse,
+    )

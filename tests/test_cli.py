@@ -198,3 +198,33 @@ def test_pipeline_summary_separates_failed_from_skipped(tmp_path, capsys):
     ]) == 1
     out = capsys.readouterr().out
     assert "pipeline failed: bad (skipped: down)" in out
+
+
+def test_eda_cli(tmp_path, capsys, devices8):
+    demand = tmp_path / "demand"
+    main([
+        "datagen", "demand", "--out", str(demand), "--skus-per-product", "1",
+    ])
+    assert main([
+        "eda", "--data", str(demand), "--horizon", "20",
+        "--seasonal-periods", "26", "--max-evals", "2", "--parallelism", "2",
+        "--max-iter", "40",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "hw_add" in out and "sarimax_exog" in out
+    assert "best SARIMAX order" in out
+
+
+def test_ingest_cli(tmp_path, capsys):
+    from test_end_to_end import _jpeg
+
+    root = tmp_path / "raw" / "Data"
+    root.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        (root / f"n0000000{i % 2}_{i}.JPEG").write_bytes(_jpeg(rng, i % 4))
+    assert main([
+        "ingest", "--data-root", str(tmp_path / "raw"), "--out",
+        str(tmp_path / "table"), "--rows-per-fragment", "4",
+    ]) == 0
+    assert "ingested 6 rows" in capsys.readouterr().out

@@ -1,0 +1,67 @@
+"""Single-SKU EDA workload: model comparison report (R11 parity)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dss_ml_at_scale_tpu.datagen.demand import DemandConfig, generate_demand
+from dss_ml_at_scale_tpu.ops import SarimaxConfig
+from dss_ml_at_scale_tpu.workloads import run_eda
+from dss_ml_at_scale_tpu.workloads.eda import extract_sku_series
+
+CFG_SMALL = SarimaxConfig(max_p=2, max_d=1, max_q=2, k_exog=3, max_iter=60)
+
+
+@pytest.fixture(scope="module")
+def demand_df():
+    return generate_demand(DemandConfig(n_skus_per_product=1, ts_length_years=3))
+
+
+def test_extract_sku_series_defaults_to_first(demand_df):
+    s = extract_sku_series(demand_df)
+    assert s["SKU"].nunique() == 1
+    assert s["Date"].is_monotonic_increasing
+    with pytest.raises(ValueError, match="no rows"):
+        extract_sku_series(demand_df, sku="NOPE")
+
+
+def test_run_eda_report(devices8, demand_df):
+    report = run_eda(
+        demand_df,
+        horizon=20,
+        seasonal_periods=26,
+        max_evals=4,
+        parallelism=4,
+        cfg=CFG_SMALL,
+    )
+    models = set(report.scores["model"])
+    # 4 HW variants + 2 SARIMAX + 1 tuned.
+    assert {"hw_add", "hw_add_damped", "hw_mul", "hw_mul_damped",
+            "sarimax_exog", "sarimax_no_exog"} <= models
+    assert any(m.startswith("sarimax_tuned") for m in models)
+    finite = report.scores["mse"].dropna()
+    assert len(finite) == 7 and (finite > 0).all()
+    # Report frame is sorted by score and carries identity columns.
+    assert report.scores["mse"].is_monotonic_increasing
+    frame = report.to_frame()
+    assert list(frame.columns[:2]) == ["Product", "SKU"]
+    assert all(0 <= o <= 2 for o in report.best_order)
+
+
+def test_run_eda_short_series_raises(demand_df):
+    small = extract_sku_series(demand_df).head(30)
+    with pytest.raises(ValueError, match="holdout"):
+        run_eda(small, horizon=40, cfg=CFG_SMALL)
+
+
+def test_extract_sku_respects_product_without_sku():
+    df = pd.DataFrame({
+        "Product": ["A", "A", "B", "B"],
+        "SKU": ["a1", "a1", "b1", "b1"],
+        "Date": pd.date_range("2021-01-04", periods=2, freq="W-MON").tolist() * 2,
+        "Demand": [1.0, 2.0, 3.0, 4.0],
+    })
+    s = extract_sku_series(df, product="B")
+    assert s["SKU"].unique().tolist() == ["b1"]
+    with pytest.raises(ValueError, match="Product='C'"):
+        extract_sku_series(df, product="C")
