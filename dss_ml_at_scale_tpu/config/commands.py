@@ -402,6 +402,25 @@ def register_hpo(sub: argparse._SubParsersAction) -> None:
     hp_.set_defaults(fn=_cmd_hpo)
 
 
+def register_trial_worker(sub: argparse._SubParsersAction) -> None:
+    tw = sub.add_parser(
+        "trial-worker",
+        help="serve HPO trial evaluations for a remote driver (one per host)",
+    )
+    tw.add_argument(
+        "--bind", default="127.0.0.1:0",
+        help="host:port to listen on (port 0 = OS-assigned, printed)",
+    )
+    tw.set_defaults(fn=_cmd_trial_worker)
+
+
+def _cmd_trial_worker(args: argparse.Namespace) -> int:
+    from ..parallel.trials import serve_trial_worker
+
+    serve_trial_worker(args.bind, block=True)
+    return 0
+
+
 def _cmd_hpo(args: argparse.Namespace) -> int:
     from ..datagen.regression import gen_data, train_and_eval, tune_alpha
     from ..hpo.shipping import load_shared
@@ -452,6 +471,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_ingest(sub)
     register_train(sub)
     register_hpo(sub)
+    register_trial_worker(sub)
     from .pipeline import register_pipeline
 
     register_pipeline(sub)

@@ -85,10 +85,19 @@ class Trials:
 
 
 def _call_objective(objective, space, point) -> dict:
-    # Protocol violations (missing/non-numeric loss) fail the TRIAL, not the
-    # sweep — same isolation as an objective that raises.
+    return call_with_protocol(objective, space_eval(space, point))
+
+
+def call_with_protocol(objective, args) -> dict:
+    """Invoke ``objective(args)`` under the trial-result protocol.
+
+    Protocol violations (missing/non-numeric loss) fail the TRIAL, not the
+    sweep — same isolation as an objective that raises. Shared by local
+    executors (post ``space_eval``) and remote trial workers (which
+    receive already-evaluated args over the wire).
+    """
     try:
-        out = objective(space_eval(space, point))
+        out = objective(args)
         if isinstance(out, Mapping):
             result = dict(out)
             result.setdefault("status", STATUS_OK)
@@ -123,8 +132,19 @@ def fmin(
     tracker=None,
     return_argmin: bool = True,
 ):
-    """Minimize ``fn`` over ``space``. Returns the best point dict."""
+    """Minimize ``fn`` over ``space``. Returns the best point dict.
+
+    ``fn`` may be a ``module:qualname`` string only when ``trials`` is an
+    executor that ships objectives by reference (``HostTrials``); local
+    executors need the callable itself.
+    """
     trials = trials if trials is not None else Trials()
+    if isinstance(fn, str) and not getattr(trials, "accepts_objective_ref", False):
+        raise TypeError(
+            f"objective given as string ref {fn!r}, but {type(trials).__name__} "
+            "evaluates locally and needs the callable (string refs are for "
+            "remote executors like HostTrials)"
+        )
     rng = (
         rstate
         if isinstance(rstate, np.random.Generator)

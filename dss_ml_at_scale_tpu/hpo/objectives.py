@@ -1,0 +1,39 @@
+"""Module-level demo objectives for distributed HPO.
+
+Remote trial workers resolve objectives by ``module:qualname`` reference
+(:func:`dss_ml_at_scale_tpu.parallel.trials.objective_ref`), so sweep
+demos and tests need importable functions — the analogue of the
+reference's notebook-global ``objective`` that SparkTrials pickles to
+executors (``hyperopt/1. hyperopt.py:54-62``).
+"""
+
+from __future__ import annotations
+
+
+def quadratic(args) -> float:
+    """Smooth 1-D bowl with minimum at x = 3."""
+    return (args["x"] - 3.0) ** 2
+
+
+def brittle_quadratic(args) -> float:
+    """Quadratic that raises on half its domain — failure-isolation probe."""
+    if args["x"] < 0:
+        raise RuntimeError(f"objective blew up at x={args['x']}")
+    return (args["x"] - 3.0) ** 2
+
+
+def lasso_shared(args) -> dict:
+    """Lasso fit against a shared-FS dataset (the ≥1 GB shipping regime).
+
+    ``args['data_path']`` names an npz written by
+    :func:`dss_ml_at_scale_tpu.hpo.shipping.save_shared`; per-process
+    caching in ``load_shared`` means N trials on a host read it once.
+    """
+    from ..datagen.regression import train_and_eval
+    from .shipping import load_shared
+
+    arrays = load_shared(args["data_path"])
+    data = (
+        arrays["X_train"], arrays["X_test"], arrays["y_train"], arrays["y_test"]
+    )
+    return train_and_eval(data, args["alpha"])

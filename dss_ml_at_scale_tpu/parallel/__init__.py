@@ -9,7 +9,12 @@ from .trainer import (  # noqa: F401
     TrainerConfig,
     TrainState,
 )
-from .trials import DeviceTrials  # noqa: F401
+from .trials import (  # noqa: F401
+    DeviceTrials,
+    HostTrials,
+    objective_ref,
+    serve_trial_worker,
+)
 from .group_apply import (  # noqa: F401
     PaddedGroups,
     batched_fmin,

@@ -19,7 +19,6 @@ bit-identical to sequential TPE — same as SparkTrials vs Trials).
 from __future__ import annotations
 
 import queue
-import threading
 import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
@@ -49,7 +48,6 @@ class DeviceTrials(Trials):
         device_pool: queue.SimpleQueue = queue.SimpleQueue()
         for d in self.devices:
             device_pool.put(d)
-        lock = threading.Lock()  # guards trial history + rng for proposals
 
         def evaluate(tid: int, point: dict) -> tuple[int, dict, dict, float]:
             t0 = time.time()
@@ -64,21 +62,189 @@ class DeviceTrials(Trials):
                 result = _call_objective(objective, space, point)
             return tid, point, result, t0
 
-        next_tid = len(self.trials)
-        submitted = next_tid
-        with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
-            pending = set()
-            while submitted < max_evals or pending:
-                while submitted < max_evals and len(pending) < self.parallelism:
-                    with lock:
-                        point = algo(space, self._history(), rng)
-                    pending.add(pool.submit(evaluate, submitted, point))
-                    submitted += 1
-                done, pending = wait(pending, return_when=FIRST_COMPLETED)
-                for fut in done:
-                    tid, point, result, t0 = fut.result()
-                    with lock:
-                        self._record(tid, point, result, t0)
-                    if tracker is not None:
-                        _log_trial(tracker, tid, point, result)
-        self.trials.sort(key=lambda t: t["tid"])
+        _run_async_pool(
+            self, evaluate, algo, space, max_evals, rng, tracker,
+            self.parallelism,
+        )
+
+
+def _run_async_pool(
+    trials, evaluate, algo, space, max_evals, rng, tracker, parallelism
+) -> None:
+    """SparkTrials-style async driver loop shared by the parallel executors.
+
+    Proposes from whatever history has completed, keeps up to
+    ``parallelism`` evaluations in flight, records results as they land.
+    Proposals and recording happen only on the calling thread;
+    ``evaluate(tid, point) -> (tid, point, result, t0)`` runs on pool
+    threads and must not touch the trial store.
+    """
+    submitted = len(trials.trials)
+    with ThreadPoolExecutor(max_workers=parallelism) as pool:
+        pending = set()
+        while submitted < max_evals or pending:
+            while submitted < max_evals and len(pending) < parallelism:
+                point = algo(space, trials._history(), rng)
+                pending.add(pool.submit(evaluate, submitted, point))
+                submitted += 1
+            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            for fut in done:
+                tid, point, result, t0 = fut.result()
+                trials._record(tid, point, result, t0)
+                if tracker is not None:
+                    _log_trial(tracker, tid, point, result)
+    trials.trials.sort(key=lambda t: t["tid"])
+
+
+# ---------------------------------------------------------------------------
+# Multi-host trials over the RPC control plane (SURVEY.md §5.8)
+# ---------------------------------------------------------------------------
+
+def objective_ref(fn) -> str:
+    """Importable ``module:qualname`` reference for a trial objective.
+
+    The wire carries a *reference*, not code: workers import the same
+    package and resolve it — the moral equivalent of Spark shipping a
+    pickled function to executors, minus arbitrary-code pickles. Closures
+    and lambdas therefore can't cross hosts; module-level functions can
+    (bind data via the :mod:`dss_ml_at_scale_tpu.hpo.shipping` modes).
+    """
+    if isinstance(fn, str):
+        return fn
+    qualname = getattr(fn, "__qualname__", "")
+    if not qualname or "<locals>" in qualname or "<lambda>" in qualname:
+        raise ValueError(
+            f"objective {fn!r} is not importable by reference; move it to "
+            "module level (data can ship via hpo.shipping)"
+        )
+    return f"{fn.__module__}:{qualname}"
+
+
+def resolve_objective(ref: str):
+    import importlib
+
+    module, _, qualname = ref.partition(":")
+    obj = importlib.import_module(module)
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def serve_trial_worker(bind: str = "127.0.0.1:0", block: bool = True):
+    """Run a trial-evaluation worker (one per host, like a Spark executor).
+
+    Exposes ``evaluate({"objective": ref, "args": kwargs}) -> result`` and
+    ``ping``. Objectives run under the trial-result protocol, so a raising
+    objective returns a ``fail`` result instead of killing the worker.
+    """
+    from ..hpo.fmin import call_with_protocol
+    from ..runtime.rpc import RpcServer
+
+    host, _, port = bind.rpartition(":")
+
+    def _evaluate(payload):
+        fn = resolve_objective(payload["objective"])
+        return call_with_protocol(fn, payload["args"])
+
+    server = RpcServer(
+        {"evaluate": _evaluate, "ping": lambda _: "pong"},
+        host or "127.0.0.1",
+        int(port),
+    )
+    print(f"trial worker listening on {server.address[0]}:{server.address[1]}",
+          flush=True)
+    if block:
+        server.serve_forever()
+        return None
+    return server.serve_background()
+
+
+class HostTrials(Trials):
+    """Distribute trials across worker hosts (the multi-host SparkTrials).
+
+    ``workers`` are ``host:port`` addresses of :func:`serve_trial_worker`
+    processes. The driver's TPE proposes; up to ``parallelism`` trials
+    evaluate concurrently, each call pinned to one worker from a pool so
+    load spreads evenly. A worker that raises — or is unreachable — fails
+    that trial only (SparkTrials isolation; the sweep continues on the
+    remaining workers).
+    """
+
+    accepts_objective_ref = True
+
+    def __init__(
+        self,
+        workers,
+        parallelism: int | None = None,
+        rpc_timeout: float = 600.0,
+        validate_ref: bool = True,
+    ):
+        super().__init__()
+        if not workers:
+            raise ValueError("HostTrials needs at least one worker address")
+        self.workers = list(workers)
+        self.parallelism = parallelism or len(self.workers)
+        self.rpc_timeout = rpc_timeout
+        self.validate_ref = validate_ref
+
+    def run(self, objective, space, algo, max_evals, rng, tracker=None) -> None:
+        from ..hpo.space import space_eval
+        from ..runtime.rpc import RpcRemoteError, rpc_call
+
+        ref = objective_ref(objective)
+        if self.validate_ref:
+            # Workers run the same package, so a typo'd ref that cannot
+            # resolve here would fail every single trial remotely; raise
+            # once up front instead (validate_ref=False for worker-only
+            # objective modules).
+            try:
+                resolve_objective(ref)
+            except Exception as e:
+                raise ValueError(
+                    f"objective ref {ref!r} does not resolve on the driver: "
+                    f"{e!r}"
+                ) from e
+        worker_pool: queue.SimpleQueue = queue.SimpleQueue()
+        for w in self.workers:
+            worker_pool.put(w)
+
+        def evaluate(tid: int, point: dict):
+            t0 = time.time()
+            try:
+                worker = worker_pool.get(timeout=self.rpc_timeout)
+            except queue.Empty:
+                return tid, point, {
+                    "status": "fail",
+                    "error": "no workers available (all busy, dead, or timed out)",
+                }, t0
+            try:
+                result = rpc_call(
+                    worker,
+                    "evaluate",
+                    {"objective": ref, "args": space_eval(space, point)},
+                    timeout=self.rpc_timeout,
+                )
+            except RpcRemoteError as e:
+                # The worker responded — it is healthy; the handler raised
+                # (e.g. unresolvable ref). Trial fails, worker returns.
+                worker_pool.put(worker)
+                result = {"status": "fail", "error": f"worker {worker}: {e}"}
+            except Exception:
+                # Transport failure: the worker is dead, or still chewing on
+                # the evaluation we just abandoned (timeout). Returning it
+                # would stack concurrent evaluations on a struggling host —
+                # drop it from the pool instead.
+                import traceback as _tb
+
+                result = {
+                    "status": "fail",
+                    "error": f"worker {worker} dropped: {_tb.format_exc()}",
+                }
+            else:
+                worker_pool.put(worker)
+            return tid, point, result, t0
+
+        _run_async_pool(
+            self, evaluate, algo, space, max_evals, rng, tracker,
+            self.parallelism,
+        )

@@ -1,0 +1,137 @@
+"""Minimal host-level RPC: the control-plane transport (SURVEY.md §5.8).
+
+The reference's control plane is Spark's driver↔executor RPC — it ships
+trial objectives to executors (``SparkTrials``) and dispatches group
+tasks (``applyInPandas``). The data plane here is XLA collectives over
+ICI/DCN inside compiled programs; this module is the *small* host-side
+complement for work that is not an SPMD program: dispatching HPO trials
+to worker hosts and similar coordinator→worker calls.
+
+Wire format: 8-byte big-endian length prefix + pickled request/response
+dicts, one request per connection. Like Spark's default RPC, this
+assumes a **trusted cluster network** (pickle is executed on receipt;
+never expose the port beyond the job's hosts).
+
+Request:  ``{"method": str, "payload": Any}``
+Response: ``{"ok": True, "value": Any}`` or
+          ``{"ok": False, "error": str (traceback)}``
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import traceback
+from typing import Any, Callable, Mapping
+
+_LEN = struct.Struct(">Q")
+_MAX_MESSAGE = 1 << 31  # 2 GiB sanity bound on a single message
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_MESSAGE:
+        raise ValueError(f"message of {n} bytes exceeds bound {_MAX_MESSAGE}")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class RpcServer:
+    """Threaded TCP server dispatching to named handler callables.
+
+    ``RpcServer({"evaluate": fn}, port=0)`` binds an OS-assigned port;
+    read it back from ``.address``. ``serve_background()`` runs the
+    accept loop on a daemon thread (workers embed it next to their main
+    loop); ``serve_forever()`` blocks (CLI worker processes).
+    """
+
+    def __init__(
+        self,
+        handlers: Mapping[str, Callable[[Any], Any]],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        recv_timeout: float = 60.0,
+    ):
+        self.handlers = dict(handlers)
+        self.recv_timeout = recv_timeout
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):  # one request per connection
+                # Bound the request-recv phase: a probe that connects but
+                # never sends a full message must not pin a handler thread
+                # forever. The handler itself (and the response send) may
+                # then take as long as the work needs.
+                self.request.settimeout(outer.recv_timeout)
+                try:
+                    req = _recv_msg(self.request)
+                except (ConnectionError, EOFError, ValueError, TimeoutError, OSError):
+                    return
+                self.request.settimeout(None)
+                try:
+                    fn = outer.handlers[req["method"]]
+                    resp = {"ok": True, "value": fn(req.get("payload"))}
+                except Exception:
+                    resp = {"ok": False, "error": traceback.format_exc()}
+                try:
+                    _send_msg(self.request, resp)
+                except ConnectionError:
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self.address: tuple[str, int] = self._server.server_address[:2]
+
+    def serve_background(self) -> "RpcServer":
+        thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def rpc_call(
+    address: tuple[str, int] | str,
+    method: str,
+    payload: Any = None,
+    timeout: float | None = 600.0,
+):
+    """One call: connect, send, await response, raise on remote error."""
+    if isinstance(address, str):
+        host, _, port = address.rpartition(":")
+        address = (host or "127.0.0.1", int(port))
+    with socket.create_connection(address, timeout=timeout) as sock:
+        _send_msg(sock, {"method": method, "payload": payload})
+        resp = _recv_msg(sock)
+    if not resp["ok"]:
+        raise RpcRemoteError(resp["error"])
+    return resp["value"]
+
+
+class RpcRemoteError(RuntimeError):
+    """The remote handler raised; message carries the remote traceback."""

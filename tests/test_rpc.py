@@ -1,0 +1,160 @@
+"""RPC control plane + multi-host trials executor (§5.8 parity)."""
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dss_ml_at_scale_tpu.hpo import STATUS_FAIL, STATUS_OK, fmin, hp
+from dss_ml_at_scale_tpu.parallel import HostTrials, objective_ref, serve_trial_worker
+from dss_ml_at_scale_tpu.parallel.trials import resolve_objective
+from dss_ml_at_scale_tpu.runtime import RpcRemoteError, RpcServer, rpc_call
+
+
+# -- transport --------------------------------------------------------------
+
+def test_rpc_roundtrip_and_remote_error():
+    server = RpcServer({
+        "echo": lambda p: p,
+        "boom": lambda p: 1 / 0,
+    }).serve_background()
+    try:
+        addr = f"{server.address[0]}:{server.address[1]}"
+        assert rpc_call(addr, "echo", {"x": [1, 2, 3]}) == {"x": [1, 2, 3]}
+        assert rpc_call(server.address, "echo", "tuple-addr ok") == "tuple-addr ok"
+        with pytest.raises(RpcRemoteError, match="ZeroDivisionError"):
+            rpc_call(addr, "boom")
+        with pytest.raises(RpcRemoteError, match="KeyError"):
+            rpc_call(addr, "no-such-method")
+    finally:
+        server.shutdown()
+
+
+def test_rpc_large_payload():
+    server = RpcServer({"size": lambda p: len(p)}).serve_background()
+    try:
+        blob = b"x" * (5 << 20)  # 5 MiB crosses several recv chunks
+        assert rpc_call(server.address, "size", blob) == len(blob)
+    finally:
+        server.shutdown()
+
+
+# -- objective references ---------------------------------------------------
+
+def test_objective_ref_roundtrip():
+    from dss_ml_at_scale_tpu.hpo import objectives
+
+    ref = objective_ref(objectives.quadratic)
+    assert ref == "dss_ml_at_scale_tpu.hpo.objectives:quadratic"
+    assert resolve_objective(ref) is objectives.quadratic
+    with pytest.raises(ValueError, match="not importable"):
+        objective_ref(lambda a: 0.0)
+
+
+# -- in-process workers -----------------------------------------------------
+
+@pytest.fixture()
+def two_workers():
+    servers = [serve_trial_worker(block=False) for _ in range(2)]
+    yield [f"{s.address[0]}:{s.address[1]}" for s in servers]
+    for s in servers:
+        s.shutdown()
+
+
+def test_host_trials_sweep(two_workers):
+    trials = HostTrials(two_workers)
+    best = fmin(
+        "dss_ml_at_scale_tpu.hpo.objectives:quadratic",
+        {"x": hp.uniform("x", -10, 10)},
+        max_evals=25,
+        trials=trials,
+        rstate=np.random.default_rng(0),
+    )
+    assert len(trials.trials) == 25
+    assert abs(best["x"] - 3.0) < 2.0  # TPE homes in on the bowl
+    assert all(t["result"]["status"] == STATUS_OK for t in trials.trials)
+
+
+def test_host_trials_failure_isolation(two_workers):
+    trials = HostTrials(two_workers)
+    best = fmin(
+        "dss_ml_at_scale_tpu.hpo.objectives:brittle_quadratic",
+        {"x": hp.uniform("x", -10, 10)},
+        max_evals=20,
+        trials=trials,
+        rstate=np.random.default_rng(1),
+    )
+    statuses = {t["result"]["status"] for t in trials.trials}
+    assert statuses == {STATUS_OK, STATUS_FAIL}  # some raised, sweep survived
+    assert best["x"] >= 0
+    failed = [t for t in trials.trials if t["result"]["status"] == STATUS_FAIL]
+    assert all("blew up" in t["result"]["error"] for t in failed)
+
+
+def test_host_trials_unreachable_worker_fails_trials_not_sweep(two_workers):
+    # One live worker + one dead address: trials routed to the dead one
+    # fail individually; the sweep still completes and finds the optimum.
+    trials = HostTrials([two_workers[0], "127.0.0.1:1"], rpc_timeout=2.0)
+    fmin(
+        "dss_ml_at_scale_tpu.hpo.objectives:quadratic",
+        {"x": hp.uniform("x", -10, 10)},
+        max_evals=10,
+        trials=trials,
+        rstate=np.random.default_rng(2),
+        return_argmin=False,
+    )
+    ok = [t for t in trials.trials if t["result"]["status"] == STATUS_OK]
+    failed = [t for t in trials.trials if t["result"]["status"] == STATUS_FAIL]
+    assert len(ok) + len(failed) == 10 and ok and failed
+    assert all("worker" in t["result"]["error"] for t in failed)
+
+
+# -- real worker process via the CLI ---------------------------------------
+
+def test_trial_worker_cli_subprocess(tmp_path):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dss_ml_at_scale_tpu.config.cli",
+         "trial-worker", "--bind", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        addr = line.strip().rsplit(" ", 1)[-1]
+        assert rpc_call(addr, "ping", timeout=10.0) == "pong"
+        trials = HostTrials([addr])
+        best = fmin(
+            "dss_ml_at_scale_tpu.hpo.objectives:quadratic",
+            {"x": hp.uniform("x", -5, 8)},
+            max_evals=8,
+            trials=trials,
+            rstate=np.random.default_rng(3),
+        )
+        assert len(trials.trials) == 8
+        assert all(t["result"]["status"] == STATUS_OK for t in trials.trials)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_fmin_rejects_string_objective_on_local_executors():
+    from dss_ml_at_scale_tpu.hpo import Trials
+
+    with pytest.raises(TypeError, match="string ref"):
+        fmin(
+            "dss_ml_at_scale_tpu.hpo.objectives:quadratic",
+            {"x": hp.uniform("x", -1, 1)},
+            max_evals=2,
+            trials=Trials(),
+        )
+
+
+def test_host_trials_validates_ref_on_driver(two_workers):
+    with pytest.raises(ValueError, match="does not resolve"):
+        fmin(
+            "dss_ml_at_scale_tpu.hpo.objectives:no_such_function",
+            {"x": hp.uniform("x", -1, 1)},
+            max_evals=2,
+            trials=HostTrials(two_workers),
+        )
