@@ -17,19 +17,18 @@ harness still exercises end to end.
 from __future__ import annotations
 
 import json
-import time
 
 A100_IMG_PER_SEC = 2500.0  # ResNet-50 train, mixed precision, per A100
 
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
 
-    from dss_ml_at_scale_tpu.models import ResNet50
-    from dss_ml_at_scale_tpu.parallel import ClassifierTask
+    from dss_ml_at_scale_tpu.utils.benchlib import (
+        build_resnet_task,
+        synthetic_image_batch,
+        timed_train_steps,
+    )
 
     on_accel = jax.devices()[0].platform != "cpu"
     # Reference per-rank batch is 212 (deep_learning/2...py:342); bf16
@@ -38,35 +37,13 @@ def main() -> None:
     image = 224 if on_accel else 64
     steps = 10 if on_accel else 2
 
-    model = ResNet50(num_classes=1000) if on_accel else ResNet50(
-        num_classes=1000, num_filters=16, dtype=jnp.float32
-    )
-    task = ClassifierTask(model=model, tx=optax.adam(1e-5))
-
-    rng = np.random.default_rng(0)
-    host_batch = {
-        "image": rng.normal(size=(batch, image, image, 3)).astype(np.float32),
-        "label": rng.integers(0, 1000, batch).astype(np.int32),
-    }
+    task = build_resnet_task(num_classes=1000, on_accel=on_accel)
+    host_batch = synthetic_image_batch(batch, image, num_classes=1000)
     state = task.init_state(jax.random.key(0), host_batch)
     device_batch = jax.device_put(host_batch)
     train_step = jax.jit(task.train_step, donate_argnums=0)
 
-    # Warmup: compile + 2 steady steps.
-    for _ in range(3):
-        state, metrics = train_step(state, device_batch)
-    jax.block_until_ready(state.params)
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = train_step(state, device_batch)
-    # Force full materialization: fetch a scalar that depends on the last
-    # step (block_until_ready alone has proven unreliable through remote
-    # device tunnels).
-    float(metrics["train_loss"])
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
-
+    _, dt = timed_train_steps(train_step, state, device_batch, steps)
     ips = batch * steps / dt
     print(
         json.dumps(

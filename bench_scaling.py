@@ -1,0 +1,103 @@
+"""DP scaling-efficiency harness: images/sec vs device count.
+
+Measures the north-star scaling metric (BASELINE.md: ≥90% efficiency
+1→32 chips) by running the same per-device batch over growing mesh
+sizes: efficiency(n) = throughput(n) / (n × throughput(1)).
+
+On a real slice this is the honest number. Without one, run on the
+CPU-simulated slice to validate the harness end to end:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python bench_scaling.py --platform cpu
+
+Prints ONE JSON line:
+    {"metric": "resnet50_dp_scaling_efficiency", "value": eff_at_max,
+     "unit": "fraction (1.0 = linear)", "per_device": {...}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def measure(task, n_devices: int, batch_per_device: int, image: int,
+            steps: int) -> float:
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dss_ml_at_scale_tpu.runtime import make_mesh
+    from dss_ml_at_scale_tpu.utils.benchlib import (
+        synthetic_image_batch,
+        timed_train_steps,
+    )
+
+    mesh = make_mesh({"data": n_devices}, devices=jax.devices()[:n_devices])
+    batch = synthetic_image_batch(
+        batch_per_device * n_devices, image, num_classes=100
+    )
+    state = task.init_state(jax.random.key(0), batch)
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    batch = {
+        "image": jax.device_put(
+            batch["image"], NamedSharding(mesh, P("data", None, None, None))
+        ),
+        "label": jax.device_put(batch["label"], NamedSharding(mesh, P("data"))),
+    }
+    replicated = NamedSharding(mesh, P())
+    step_fn = jax.jit(
+        task.train_step, donate_argnums=0,
+        out_shardings=(replicated, replicated),
+    )
+    _, dt = timed_train_steps(step_fn, state, batch, steps)
+    return batch_per_device * n_devices * steps / dt
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--platform", default=None, help="force jax platform")
+    parser.add_argument("--batch-per-device", type=int, default=None)
+    parser.add_argument("--image", type=int, default=None)
+    parser.add_argument("--steps", type=int, default=5)
+    args = parser.parse_args()
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax
+
+    from dss_ml_at_scale_tpu.utils.benchlib import build_resnet_task
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    batch_per_device = args.batch_per_device or (64 if on_accel else 4)
+    image = args.image or (224 if on_accel else 32)
+    task = build_resnet_task(
+        num_classes=100, on_accel=on_accel, learning_rate=1e-4
+    )
+
+    n_max = len(jax.devices())
+    sizes = [n for n in (1, 2, 4, 8, 16, 32) if n <= n_max]
+    per_device: dict[str, float] = {}
+    for n in sizes:
+        per_device[str(n)] = round(
+            measure(task, n, batch_per_device, image, args.steps), 2
+        )
+    base = per_device[str(sizes[0])]
+    eff = per_device[str(sizes[-1])] / (sizes[-1] * base) if base else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_dp_scaling_efficiency",
+                "value": round(eff, 4),
+                "unit": f"fraction at {sizes[-1]}x {jax.devices()[0].device_kind}"
+                " (1.0 = linear)",
+                "per_device": per_device,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

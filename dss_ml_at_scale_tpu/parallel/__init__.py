@@ -5,6 +5,7 @@ from .ring import ring_attention  # noqa: F401
 
 from .trainer import (  # noqa: F401
     ClassifierTask,
+    LMTask,
     Trainer,
     TrainerConfig,
     TrainState,

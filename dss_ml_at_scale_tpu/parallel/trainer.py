@@ -74,6 +74,10 @@ class ClassifierTask:
     image_key: str = "image"
     label_key: str = "label"
 
+    # Best-checkpoint selection when TrainerConfig doesn't specify one.
+    default_best_metric = "val_acc"
+    default_best_mode = "max"
+
     def __post_init__(self):
         if self.tx is None:
             self.tx = optax.adam(self.learning_rate)
@@ -146,6 +150,72 @@ class ClassifierTask:
 
 
 @dataclasses.dataclass
+class LMTask:
+    """Causal language-model task for the same Trainer loop.
+
+    The classifier track is the reference's only trained model family;
+    the LM task extends the trainer to the transformer stack (flash /
+    ring attention) so sequence-parallel training rides the identical
+    epoch/step/checkpoint machinery. Batches carry ``tokens`` [B, S]
+    int32; loss is next-token cross entropy.
+    """
+
+    model: Any
+    tx: optax.GradientTransformation | None = None
+    learning_rate: float = 3e-4
+    tokens_key: str = "tokens"
+
+    def __post_init__(self):
+        if self.tx is None:
+            self.tx = optax.adam(self.learning_rate)
+
+    # Best-checkpoint selection when TrainerConfig doesn't specify one:
+    # language models track validation loss (lower is better).
+    default_best_metric = "val_loss"
+    default_best_mode = "min"
+
+    def init_state(self, rng, sample_batch: Batch) -> TrainState:
+        tokens = jnp.asarray(sample_batch[self.tokens_key])
+        params = self.model.init(rng, tokens[:1])["params"]
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=FrozenDict(),
+            opt_state=self.tx.init(params),
+        )
+
+    def train_step(self, state: TrainState, batch: Batch):
+        from ..models.transformer import next_token_loss
+
+        tokens = jnp.asarray(batch[self.tokens_key])
+
+        def loss_fn(params):
+            logits = self.model.apply({"params": params}, tokens)
+            return next_token_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=state.batch_stats,
+                opt_state=new_opt,
+            ),
+            {"train_loss": loss, "train_ppl": jnp.exp(loss)},
+        )
+
+    def eval_step(self, state: TrainState, batch: Batch):
+        from ..models.transformer import next_token_loss
+
+        tokens = jnp.asarray(batch[self.tokens_key])
+        logits = self.model.apply({"params": state.params}, tokens)
+        loss = next_token_loss(logits, tokens)
+        return {"val_loss": loss, "val_ppl": jnp.exp(loss)}
+
+
+@dataclasses.dataclass
 class TrainerConfig:
     max_epochs: int = 2                      # reference MAX_EPOCHS (2...py:343)
     steps_per_epoch: int | None = None       # else rows // (batch × world)
@@ -154,8 +224,10 @@ class TrainerConfig:
     log_every_steps: int = 10
     checkpoint_dir: str | None = None
     keep_checkpoints: int = 2
-    best_metric: str = "val_acc"
-    best_mode: str = "max"
+    # None = use the task's default_best_metric/default_best_mode
+    # (val_acc/max for classifiers, val_loss/min for LMs).
+    best_metric: str | None = None
+    best_mode: str | None = None
     resume: bool = False
     prefetch_depth: int = 2
     # jax.profiler trace capture (SURVEY.md §5.1): when profile_dir is
@@ -217,6 +289,15 @@ class Trainer:
         state: TrainState | None = None,
     ) -> FitResult:
         cfg = self.config
+        if cfg.best_metric is None or cfg.best_mode is None:
+            cfg = dataclasses.replace(
+                cfg,
+                best_metric=cfg.best_metric
+                or getattr(task, "default_best_metric", "val_acc"),
+                best_mode=cfg.best_mode
+                or getattr(task, "default_best_mode", "max"),
+            )
+            self.config = cfg  # helpers (_checkpoint_manager, _prior_best) read it
         mesh = self.mesh
         rng = rng if rng is not None else jax.random.key(0)
 

@@ -1,0 +1,56 @@
+"""Shared benchmark scaffolding for bench.py / bench_scaling.py.
+
+One place for the model/task construction, synthetic batches, and the
+timing methodology — in particular the sync discipline: through remote
+device tunnels ``block_until_ready`` has proven unreliable, so timing
+windows end by fetching a scalar that data-depends on the last step.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def build_resnet_task(num_classes: int, on_accel: bool,
+                      learning_rate: float = 1e-5):
+    """Benchmark ResNet-50: full-size bf16 on accelerators, a small f32
+    stand-in on CPU (where the number is a harness check, not a result)."""
+    import jax.numpy as jnp
+    import optax
+
+    from ..models import ResNet50
+    from ..parallel import ClassifierTask
+
+    model = ResNet50(num_classes=num_classes) if on_accel else ResNet50(
+        num_classes=num_classes, num_filters=8, dtype=jnp.float32
+    )
+    return ClassifierTask(model=model, tx=optax.adam(learning_rate))
+
+
+def synthetic_image_batch(batch: int, image: int, num_classes: int,
+                          seed: int = 0) -> dict:
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return {
+        "image": rng.normal(size=(batch, image, image, 3)).astype(np.float32),
+        "label": rng.integers(0, num_classes, batch).astype(np.int32),
+    }
+
+
+def timed_train_steps(step_fn, state, batch, steps: int,
+                      loss_key: str = "train_loss", warmup: int = 2):
+    """(state, seconds) for ``steps`` chained calls after ``warmup``.
+
+    Ends the window with a scalar fetch that depends on the final step —
+    the only sync that holds through remote device tunnels.
+    """
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch)
+    float(metrics[loss_key])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+    float(metrics[loss_key])
+    return state, time.perf_counter() - t0
