@@ -399,6 +399,12 @@ def register_hpo(sub: argparse._SubParsersAction) -> None:
     hp_.add_argument("--bytes", type=float, default=1e6, dest="n_bytes")
     hp_.add_argument("--parallelism", type=int, default=2)
     hp_.add_argument("--max-evals", type=int, default=4)
+    hp_.add_argument(
+        "--workers", default=None,
+        help="comma-separated trial-worker host:port addresses; runs the "
+        "sweep over the RPC control plane (requires --data on a path "
+        "every worker can read)",
+    )
     hp_.set_defaults(fn=_cmd_hpo)
 
 
@@ -424,6 +430,38 @@ def _cmd_trial_worker(args: argparse.Namespace) -> int:
 def _cmd_hpo(args: argparse.Namespace) -> int:
     from ..datagen.regression import gen_data, train_and_eval, tune_alpha
     from ..hpo.shipping import load_shared
+
+    if args.workers:
+        # Remote mode: objective ships by module reference, data by
+        # shared FS — the multi-host SparkTrials shape.
+        if not args.data:
+            print("--workers requires --data (shared-FS npz every worker can read)")
+            return 2
+        import numpy as np
+
+        from ..hpo import fmin, hp
+        from ..parallel import HostTrials
+
+        space = {
+            "alpha": hp.uniform("alpha", 0.0, 10.0),
+            "data_path": hp.choice("data_path", [str(args.data)]),
+        }
+        trials = HostTrials(
+            args.workers.split(","), parallelism=args.parallelism
+        )
+        best = fmin(
+            "dss_ml_at_scale_tpu.hpo.objectives:lasso_shared",
+            space,
+            max_evals=args.max_evals,
+            trials=trials,
+            rstate=np.random.default_rng(0),
+        )
+        ok = sum(1 for t in trials.trials if t["result"]["status"] == "ok")
+        print(
+            f"hpo (remote, {len(trials.workers)} workers): best alpha "
+            f"{best['alpha']:.4f} ({ok}/{len(trials.trials)} trials ok)"
+        )
+        return 0
 
     if args.data:
         arrays = load_shared(args.data)

@@ -108,24 +108,31 @@ def run_pipeline(args: argparse.Namespace) -> int:
             continue
         argv = render(t["argv"])
         timeout = t.get("timeout_seconds", default_timeout)
+        # Spark-style task retry (the reference's implicit failure handling,
+        # SURVEY.md §5.3): max_retries extra attempts before giving up.
+        attempts = 1 + int(t.get("max_retries", 0))
         print(f"[{key}] dsst {' '.join(argv)}")
-        t0 = time.perf_counter()
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-m", "dss_ml_at_scale_tpu.config.cli", *argv],
-                timeout=timeout,
-            )
-            code = proc.returncode
-        except subprocess.TimeoutExpired:
-            print(f"[{key}] TIMEOUT after {timeout}s")
-            failed.add(key)
-            continue
-        dt = time.perf_counter() - t0
-        if code != 0:
-            print(f"[{key}] FAILED (exit {code}, {dt:.1f}s)")
-            failed.add(key)
+        for attempt in range(attempts):
+            t0 = time.perf_counter()
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-m", "dss_ml_at_scale_tpu.config.cli", *argv],
+                    timeout=timeout,
+                )
+                code = proc.returncode
+            except subprocess.TimeoutExpired:
+                print(f"[{key}] TIMEOUT after {timeout}s "
+                      f"(attempt {attempt + 1}/{attempts})")
+                code = None
+            dt = time.perf_counter() - t0
+            if code == 0:
+                print(f"[{key}] ok ({dt:.1f}s)")
+                break
+            if code is not None:
+                print(f"[{key}] FAILED (exit {code}, {dt:.1f}s, "
+                      f"attempt {attempt + 1}/{attempts})")
         else:
-            print(f"[{key}] ok ({dt:.1f}s)")
+            failed.add(key)
     if failed:
         skipped_note = (
             f" (skipped: {', '.join(sorted(skipped))})" if skipped else ""

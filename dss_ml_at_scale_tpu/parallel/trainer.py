@@ -288,6 +288,9 @@ class Trainer:
         rng: jax.Array | None = None,
         state: TrainState | None = None,
     ) -> FitResult:
+        # Resolve task-default best metric into a LOCAL cfg only — the same
+        # Trainer may fit different task types, so self.config must keep
+        # its None sentinels.
         cfg = self.config
         if cfg.best_metric is None or cfg.best_mode is None:
             cfg = dataclasses.replace(
@@ -297,7 +300,6 @@ class Trainer:
                 best_mode=cfg.best_mode
                 or getattr(task, "default_best_mode", "max"),
             )
-            self.config = cfg  # helpers (_checkpoint_manager, _prior_best) read it
         mesh = self.mesh
         rng = rng if rng is not None else jax.random.key(0)
 

@@ -228,3 +228,51 @@ def test_ingest_cli(tmp_path, capsys):
         str(tmp_path / "table"), "--rows-per-fragment", "4",
     ]) == 0
     assert "ingested 6 rows" in capsys.readouterr().out
+
+
+def test_pipeline_retries_until_success(tmp_path, capsys):
+    # Task succeeds only once a marker file exists; first attempt creates
+    # it via a failing-then-passing wrapper is overkill — instead verify
+    # retry accounting on a task that always fails with max_retries=2.
+    spec = {
+        "tasks": [
+            {"task_key": "flaky",
+             "argv": ["datagen", "bom", "--demand", "{workdir}/missing",
+                      "--out", "{workdir}/b", "--mapper-out", "{workdir}/m"],
+             "max_retries": 2},
+        ],
+    }
+    spec_path = tmp_path / "s.json"
+    spec_path.write_text(json.dumps(spec))
+    assert main([
+        "pipeline", "--spec", str(spec_path), "--workdir", str(tmp_path),
+    ]) == 1
+    out = capsys.readouterr().out
+    assert "attempt 1/3" in out and "attempt 3/3" in out
+
+
+def test_hpo_remote_workers_cli(tmp_path, capsys):
+    npz = tmp_path / "reg.npz"
+    main(["datagen", "regression", "--bytes", "200000", "--out", str(npz)])
+    capsys.readouterr()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dss_ml_at_scale_tpu.config.cli",
+         "trial-worker", "--bind", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        addr = proc.stdout.readline().strip().rsplit(" ", 1)[-1]
+        assert main([
+            "hpo", "--workers", addr, "--data", str(npz),
+            "--max-evals", "3", "--parallelism", "1",
+        ]) == 0
+        out = capsys.readouterr().out
+        assert "remote, 1 workers" in out and "3/3 trials ok" in out
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_hpo_remote_workers_requires_data(capsys):
+    assert main(["hpo", "--workers", "127.0.0.1:1"]) == 2
+    assert "requires --data" in capsys.readouterr().out
