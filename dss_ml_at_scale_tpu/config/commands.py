@@ -16,8 +16,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+from pathlib import Path
 
 
 # --------------------------------------------------------------------------
@@ -405,7 +407,24 @@ def register_hpo(sub: argparse._SubParsersAction) -> None:
         "sweep over the RPC control plane (requires --data on a path "
         "every worker can read)",
     )
+    hp_.add_argument(
+        "--secret-file", default=None,
+        help="file holding the shared RPC secret (or env DSST_RPC_SECRET); "
+        "enables the HMAC handshake with the workers",
+    )
     hp_.set_defaults(fn=_cmd_hpo)
+
+
+def _rpc_secret(args: argparse.Namespace) -> bytes | None:
+    """Shared RPC secret from --secret-file or env DSST_RPC_SECRET."""
+    path = getattr(args, "secret_file", None)
+    if path:
+        secret = Path(path).read_bytes().strip()
+        if not secret:
+            raise SystemExit(f"--secret-file {path} is empty")
+        return secret
+    env = os.environ.get("DSST_RPC_SECRET")
+    return env.encode() if env else None
 
 
 def register_trial_worker(sub: argparse._SubParsersAction) -> None:
@@ -417,13 +436,28 @@ def register_trial_worker(sub: argparse._SubParsersAction) -> None:
         "--bind", default="127.0.0.1:0",
         help="host:port to listen on (port 0 = OS-assigned, printed)",
     )
+    tw.add_argument(
+        "--secret-file", default=None,
+        help="file holding the shared RPC secret (or env DSST_RPC_SECRET); "
+        "required for non-loopback binds unless --insecure",
+    )
+    tw.add_argument(
+        "--insecure", action="store_true",
+        help="allow a non-loopback bind without a secret (trusted isolated "
+        "network only; the RPC wire executes pickle on receipt)",
+    )
     tw.set_defaults(fn=_cmd_trial_worker)
 
 
 def _cmd_trial_worker(args: argparse.Namespace) -> int:
     from ..parallel.trials import serve_trial_worker
 
-    serve_trial_worker(args.bind, block=True)
+    serve_trial_worker(
+        args.bind,
+        block=True,
+        secret=_rpc_secret(args),
+        allow_insecure=args.insecure,
+    )
     return 0
 
 
@@ -447,7 +481,9 @@ def _cmd_hpo(args: argparse.Namespace) -> int:
             "data_path": hp.choice("data_path", [str(args.data)]),
         }
         trials = HostTrials(
-            args.workers.split(","), parallelism=args.parallelism
+            args.workers.split(","),
+            parallelism=args.parallelism,
+            secret=_rpc_secret(args),
         )
         best = fmin(
             "dss_ml_at_scale_tpu.hpo.objectives:lasso_shared",

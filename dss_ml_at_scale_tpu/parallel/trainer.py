@@ -318,7 +318,10 @@ class Trainer:
         eval_step = jax.jit(task.eval_step, out_shardings=replicated)
 
         # Track-best only matters when something produces the metric.
-        manager = self._checkpoint_manager(use_best=val_data_factory is not None)
+        # Pass the RESOLVED cfg — self.config keeps None sentinels.
+        manager = self._checkpoint_manager(
+            cfg, use_best=val_data_factory is not None
+        )
         start_epoch = 0
         if manager is not None and cfg.resume and manager.latest_step() is not None:
             state = self._restore(manager, state)
@@ -333,7 +336,7 @@ class Trainer:
         )
 
         history: list[dict] = []
-        best_value, best_step = self._prior_best(manager)
+        best_value, best_step = self._prior_best(manager, cfg)
         sign = 1.0 if cfg.best_mode == "max" else -1.0
         step = int(state.step)  # host-side mirror, synced once before the loop
         data_exhausted = False
@@ -469,8 +472,9 @@ class Trainer:
 
     # -- checkpointing ----------------------------------------------------
 
-    def _checkpoint_manager(self, use_best: bool):
-        cfg = self.config
+    def _checkpoint_manager(self, cfg: TrainerConfig, use_best: bool):
+        # cfg must be the fit()-resolved config: self.config may still hold
+        # the best_metric/best_mode None sentinels, which orbax rejects.
         if cfg.checkpoint_dir is None:
             return None
         ocp = _ocp()
@@ -484,17 +488,19 @@ class Trainer:
         )
         return ocp.CheckpointManager(Path(cfg.checkpoint_dir).absolute(), options=options)
 
-    def _prior_best(self, manager) -> tuple[float | None, int | None]:
+    def _prior_best(
+        self, manager, cfg: TrainerConfig
+    ) -> tuple[float | None, int | None]:
         """Recover best-so-far from a resumed manager so a worse post-resume
         epoch can't claim best_checkpoint_path."""
-        if manager is None or not self.config.resume:
+        if manager is None or not cfg.resume:
             return None, None
         try:
             best_step = manager.best_step()
             if best_step is None:
                 return None, None
             all_metrics = manager.metrics(best_step)
-            return (all_metrics or {}).get(self.config.best_metric), best_step
+            return (all_metrics or {}).get(cfg.best_metric), best_step
         except Exception:
             return None, None
 

@@ -19,6 +19,7 @@ bit-identical to sequential TPE — same as SparkTrials vs Trials).
 from __future__ import annotations
 
 import queue
+import threading
 import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
@@ -130,12 +131,19 @@ def resolve_objective(ref: str):
     return obj
 
 
-def serve_trial_worker(bind: str = "127.0.0.1:0", block: bool = True):
+def serve_trial_worker(
+    bind: str = "127.0.0.1:0",
+    block: bool = True,
+    secret: bytes | str | None = None,
+    allow_insecure: bool = False,
+):
     """Run a trial-evaluation worker (one per host, like a Spark executor).
 
     Exposes ``evaluate({"objective": ref, "args": kwargs}) -> result`` and
     ``ping``. Objectives run under the trial-result protocol, so a raising
     objective returns a ``fail`` result instead of killing the worker.
+    Non-loopback binds require ``secret`` (HMAC handshake; see
+    :mod:`dss_ml_at_scale_tpu.runtime.rpc`) unless ``allow_insecure``.
     """
     from ..hpo.fmin import call_with_protocol
     from ..runtime.rpc import RpcServer
@@ -150,6 +158,8 @@ def serve_trial_worker(bind: str = "127.0.0.1:0", block: bool = True):
         {"evaluate": _evaluate, "ping": lambda _: "pong"},
         host or "127.0.0.1",
         int(port),
+        secret=secret,
+        allow_insecure=allow_insecure,
     )
     print(f"trial worker listening on {server.address[0]}:{server.address[1]}",
           flush=True)
@@ -178,6 +188,7 @@ class HostTrials(Trials):
         parallelism: int | None = None,
         rpc_timeout: float = 600.0,
         validate_ref: bool = True,
+        secret: bytes | str | None = None,
     ):
         super().__init__()
         if not workers:
@@ -186,6 +197,7 @@ class HostTrials(Trials):
         self.parallelism = parallelism or len(self.workers)
         self.rpc_timeout = rpc_timeout
         self.validate_ref = validate_ref
+        self.secret = secret
 
     def run(self, objective, space, algo, max_evals, rng, tracker=None) -> None:
         from ..hpo.space import space_eval
@@ -207,15 +219,40 @@ class HostTrials(Trials):
         worker_pool: queue.SimpleQueue = queue.SimpleQueue()
         for w in self.workers:
             worker_pool.put(w)
+        # Live-worker accounting so a sweep whose workers all die fails
+        # the remaining trials immediately instead of each one waiting
+        # out rpc_timeout in worker_pool.get (max_evals × timeout stall).
+        live_lock = threading.Lock()
+        live_count = len(self.workers)
+        pool_dead = threading.Event()
+
+        def drop_worker() -> None:
+            nonlocal live_count
+            with live_lock:
+                live_count -= 1
+                if live_count <= 0:
+                    pool_dead.set()
+
+        def get_worker():
+            """Pool get that aborts as soon as the pool has no live workers."""
+            deadline = time.monotonic() + self.rpc_timeout
+            while not pool_dead.is_set():
+                try:
+                    return worker_pool.get(
+                        timeout=min(0.1, max(0.0, deadline - time.monotonic()))
+                    )
+                except queue.Empty:
+                    if time.monotonic() >= deadline:
+                        return None
+            return None
 
         def evaluate(tid: int, point: dict):
             t0 = time.time()
-            try:
-                worker = worker_pool.get(timeout=self.rpc_timeout)
-            except queue.Empty:
+            worker = get_worker()
+            if worker is None:
                 return tid, point, {
                     "status": "fail",
-                    "error": "no workers available (all busy, dead, or timed out)",
+                    "error": "no live workers (all busy, dead, or timed out)",
                 }, t0
             try:
                 result = rpc_call(
@@ -223,6 +260,7 @@ class HostTrials(Trials):
                     "evaluate",
                     {"objective": ref, "args": space_eval(space, point)},
                     timeout=self.rpc_timeout,
+                    secret=self.secret,
                 )
             except RpcRemoteError as e:
                 # The worker responded — it is healthy; the handler raised
@@ -236,6 +274,7 @@ class HostTrials(Trials):
                 # drop it from the pool instead.
                 import traceback as _tb
 
+                drop_worker()
                 result = {
                     "status": "fail",
                     "error": f"worker {worker} dropped: {_tb.format_exc()}",

@@ -8,9 +8,13 @@ complement for work that is not an SPMD program: dispatching HPO trials
 to worker hosts and similar coordinator→worker calls.
 
 Wire format: 8-byte big-endian length prefix + pickled request/response
-dicts, one request per connection. Like Spark's default RPC, this
-assumes a **trusted cluster network** (pickle is executed on receipt;
-never expose the port beyond the job's hosts).
+dicts, one request per connection. Pickle is executed on receipt, so the
+transport authenticates peers before any unpickling: when a ``secret``
+is configured, both sides run a mutual HMAC-SHA256 challenge handshake
+(multiprocessing.connection style) over raw length-prefixed frames —
+nothing is unpickled from an unauthenticated peer. Loopback binds may
+omit the secret; binding a non-loopback interface without one raises
+unless ``allow_insecure=True`` is passed explicitly.
 
 Request:  ``{"method": str, "payload": Any}``
 Response: ``{"ok": True, "value": Any}`` or
@@ -19,6 +23,8 @@ Response: ``{"ok": True, "value": Any}`` or
 
 from __future__ import annotations
 
+import hmac
+import os
 import pickle
 import socket
 import socketserver
@@ -29,6 +35,19 @@ from typing import Any, Callable, Mapping
 
 _LEN = struct.Struct(">Q")
 _MAX_MESSAGE = 1 << 31  # 2 GiB sanity bound on a single message
+
+_CHALLENGE = b"#DSST_CHALLENGE#"
+_WELCOME = b"#DSST_WELCOME#"
+_FAILURE = b"#DSST_FAILURE#"
+_NONCE_BYTES = 32
+_MAX_HANDSHAKE = 128  # raw handshake frames are tiny; bound them hard
+
+# Note: "" is NOT loopback — socketserver binds ("", port) to INADDR_ANY.
+_LOOPBACK_HOSTS = ("127.0.0.1", "localhost", "::1")
+
+
+class RpcAuthError(ConnectionError):
+    """HMAC challenge handshake failed (wrong or missing shared secret)."""
 
 
 def _send_msg(sock: socket.socket, obj: Any) -> None:
@@ -53,6 +72,51 @@ def _recv_msg(sock: socket.socket) -> Any:
     return pickle.loads(_recv_exact(sock, n))
 
 
+# -- authentication handshake (raw frames only — no pickle before auth) -----
+
+def _send_raw(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_raw(sock: socket.socket, max_len: int = _MAX_HANDSHAKE) -> bytes:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > max_len:
+        raise RpcAuthError(f"handshake frame of {n} bytes exceeds {max_len}")
+    return _recv_exact(sock, n)
+
+
+def _normalize_secret(secret: bytes | str | None) -> bytes | None:
+    if secret is None:
+        return None
+    key = secret.encode() if isinstance(secret, str) else bytes(secret)
+    if not key:
+        # An empty key would satisfy the bind guard while authenticating
+        # nothing (HMAC with b"" is computable by anyone).
+        raise ValueError("RPC secret must be non-empty (or None)")
+    return key
+
+
+def _deliver_challenge(sock: socket.socket, secret: bytes) -> None:
+    nonce = os.urandom(_NONCE_BYTES)
+    _send_raw(sock, _CHALLENGE + nonce)
+    digest = _recv_raw(sock)
+    expected = hmac.new(secret, nonce, "sha256").digest()
+    if not hmac.compare_digest(digest, expected):
+        _send_raw(sock, _FAILURE)
+        raise RpcAuthError("peer failed HMAC challenge (wrong secret)")
+    _send_raw(sock, _WELCOME)
+
+
+def _answer_challenge(sock: socket.socket, secret: bytes) -> None:
+    msg = _recv_raw(sock)
+    if not msg.startswith(_CHALLENGE):
+        raise RpcAuthError("peer did not send an HMAC challenge")
+    nonce = msg[len(_CHALLENGE):]
+    _send_raw(sock, hmac.new(secret, nonce, "sha256").digest())
+    if _recv_raw(sock) != _WELCOME:
+        raise RpcAuthError("peer rejected our HMAC digest (wrong secret)")
+
+
 class RpcServer:
     """Threaded TCP server dispatching to named handler callables.
 
@@ -68,9 +132,22 @@ class RpcServer:
         host: str = "127.0.0.1",
         port: int = 0,
         recv_timeout: float = 60.0,
+        secret: bytes | str | None = None,
+        allow_insecure: bool = False,
     ):
         self.handlers = dict(handlers)
         self.recv_timeout = recv_timeout
+        self.secret = _normalize_secret(secret)
+        if (
+            self.secret is None
+            and not allow_insecure
+            and host not in _LOOPBACK_HOSTS
+        ):
+            raise ValueError(
+                f"refusing to bind {host!r} without a shared secret: the RPC "
+                "wire executes pickle on receipt. Pass secret=..., or "
+                "allow_insecure=True on a trusted isolated network."
+            )
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -81,6 +158,11 @@ class RpcServer:
                 # then take as long as the work needs.
                 self.request.settimeout(outer.recv_timeout)
                 try:
+                    if outer.secret is not None:
+                        # Authenticate BEFORE any unpickling; mutual, so the
+                        # client also verifies us before trusting responses.
+                        _deliver_challenge(self.request, outer.secret)
+                        _answer_challenge(self.request, outer.secret)
                     req = _recv_msg(self.request)
                 except (ConnectionError, EOFError, ValueError, TimeoutError, OSError):
                     return
@@ -100,18 +182,25 @@ class RpcServer:
             daemon_threads = True
 
         self._server = _Server((host, port), _Handler)
+        self._serving = False
         self.address: tuple[str, int] = self._server.server_address[:2]
 
     def serve_background(self) -> "RpcServer":
+        self._serving = True
         thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         thread.start()
         return self
 
     def serve_forever(self) -> None:
+        self._serving = True
         self._server.serve_forever()
 
     def shutdown(self) -> None:
-        self._server.shutdown()
+        # socketserver's shutdown() waits on a flag that only serve_forever
+        # sets — calling it on a never-served server blocks forever. Skip
+        # straight to closing the listen socket in that case.
+        if self._serving:
+            self._server.shutdown()
         self._server.server_close()
 
 
@@ -120,12 +209,34 @@ def rpc_call(
     method: str,
     payload: Any = None,
     timeout: float | None = 600.0,
+    secret: bytes | str | None = None,
 ):
-    """One call: connect, send, await response, raise on remote error."""
+    """One call: connect, send, await response, raise on remote error.
+
+    With ``secret`` set, answers the server's HMAC challenge and issues
+    our own before anything is unpickled from the connection.
+    """
     if isinstance(address, str):
         host, _, port = address.rpartition(":")
         address = (host or "127.0.0.1", int(port))
+    key = _normalize_secret(secret)
     with socket.create_connection(address, timeout=timeout) as sock:
+        if key is not None:
+            # Handshake frames are tiny; a server that doesn't speak the
+            # auth protocol (no secret configured) simply never sends the
+            # challenge. Bound that wait tightly and name the cause, so a
+            # driver/worker secret mismatch fails in seconds with an auth
+            # error rather than stalling out the full call timeout.
+            sock.settimeout(min(10.0, timeout) if timeout else 10.0)
+            try:
+                _answer_challenge(sock, key)
+                _deliver_challenge(sock, key)
+            except (TimeoutError, socket.timeout) as e:
+                raise RpcAuthError(
+                    f"handshake with {address} timed out — peer likely has "
+                    "no secret configured (or a different protocol)"
+                ) from e
+            sock.settimeout(timeout)
         _send_msg(sock, {"method": method, "payload": payload})
         resp = _recv_msg(sock)
     if not resp["ok"]:

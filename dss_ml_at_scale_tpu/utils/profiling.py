@@ -55,34 +55,44 @@ class StepTimer:
     """Rolling per-step wall-time recorder.
 
     ``tick()`` marks a step boundary; intervals between consecutive ticks
-    are recorded. The first interval after construction or :meth:`reset`
-    is discarded by :meth:`summary` when ``drop_first`` (compile step).
+    are recorded. With ``skip_first_interval`` (default) the first
+    recorded interval after construction is discarded at record time —
+    that interval spans the jit compile of the first step. Discarding at
+    the recorder, not in :meth:`summary`, keeps the stats honest after
+    ring-buffer eviction and across per-epoch :meth:`reset` calls (epochs
+    ≥ 2 have no compile step, so ``reset`` does not re-arm the skip
+    unless asked).
     """
 
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int = 4096, skip_first_interval: bool = True):
         self.capacity = capacity
         self._times: list[float] = []
         self._last: float | None = None
+        self._skip_next = skip_first_interval
 
-    def reset(self) -> None:
+    def reset(self, *, skip_next_interval: bool = False) -> None:
         self._times.clear()
         self._last = None
+        self._skip_next = skip_next_interval
 
     def tick(self) -> None:
         now = time.perf_counter()
         if self._last is not None:
-            if len(self._times) >= self.capacity:
-                self._times.pop(0)
-            self._times.append(now - self._last)
+            if self._skip_next:
+                self._skip_next = False
+            else:
+                if len(self._times) >= self.capacity:
+                    self._times.pop(0)
+                self._times.append(now - self._last)
         self._last = now
 
     @property
     def intervals(self) -> list[float]:
         return list(self._times)
 
-    def summary(self, *, drop_first: bool = True) -> dict[str, float]:
+    def summary(self) -> dict[str, float]:
         """Mean / p50 / p90 / max step seconds and steps/sec."""
-        xs = self._times[1:] if drop_first else self._times
+        xs = self._times
         if not xs:
             return {}
         xs_sorted = sorted(xs)

@@ -10,7 +10,12 @@ import pytest
 from dss_ml_at_scale_tpu.hpo import STATUS_FAIL, STATUS_OK, fmin, hp
 from dss_ml_at_scale_tpu.parallel import HostTrials, objective_ref, serve_trial_worker
 from dss_ml_at_scale_tpu.parallel.trials import resolve_objective
-from dss_ml_at_scale_tpu.runtime import RpcRemoteError, RpcServer, rpc_call
+from dss_ml_at_scale_tpu.runtime import (
+    RpcAuthError,
+    RpcRemoteError,
+    RpcServer,
+    rpc_call,
+)
 
 
 # -- transport --------------------------------------------------------------
@@ -37,6 +42,58 @@ def test_rpc_large_payload():
     try:
         blob = b"x" * (5 << 20)  # 5 MiB crosses several recv chunks
         assert rpc_call(server.address, "size", blob) == len(blob)
+    finally:
+        server.shutdown()
+
+
+def test_rpc_hmac_handshake():
+    server = RpcServer(
+        {"echo": lambda p: p}, secret=b"team-secret", recv_timeout=2.0
+    ).serve_background()
+    try:
+        # Matching secret: mutual challenge passes, call succeeds.
+        assert rpc_call(server.address, "echo", 42, secret=b"team-secret") == 42
+        # Wrong secret: server rejects our digest before unpickling anything.
+        with pytest.raises((RpcAuthError, ConnectionError)):
+            rpc_call(server.address, "echo", 42, secret=b"wrong", timeout=2.0)
+        # No secret: the server speaks challenge frames, not pickle — the
+        # client chokes on the raw challenge and the request is never
+        # dispatched (server read it as a digest and rejected it).
+        import pickle as _pickle
+
+        with pytest.raises((ConnectionError, EOFError, OSError,
+                            _pickle.UnpicklingError)):
+            rpc_call(server.address, "echo", 42, timeout=2.0)
+        # Server still healthy for authenticated callers afterwards.
+        assert rpc_call(server.address, "echo", "ok", secret="team-secret") == "ok"
+    finally:
+        server.shutdown()
+
+
+def test_rpc_refuses_nonloopback_bind_without_secret():
+    with pytest.raises(ValueError, match="shared secret"):
+        RpcServer({"echo": lambda p: p}, host="0.0.0.0")
+    # "" binds INADDR_ANY too — must not slip through as loopback.
+    with pytest.raises(ValueError, match="shared secret"):
+        RpcServer({"echo": lambda p: p}, host="")
+    # An empty secret authenticates nothing; reject it outright.
+    with pytest.raises(ValueError, match="non-empty"):
+        RpcServer({"echo": lambda p: p}, host="0.0.0.0", secret=b"")
+    # Explicit opt-outs both work.
+    RpcServer({"echo": lambda p: p}, host="0.0.0.0", secret=b"s").shutdown()
+    RpcServer({"echo": lambda p: p}, host="0.0.0.0", allow_insecure=True).shutdown()
+
+
+def test_rpc_secret_mismatch_fails_fast_with_auth_error():
+    # Driver has a secret, worker does not: the client must fail within
+    # its short handshake window naming auth, not stall out the full call
+    # timeout with an opaque transport error.
+    server = RpcServer({"echo": lambda p: p}, recv_timeout=30.0).serve_background()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RpcAuthError, match="handshake"):
+            rpc_call(server.address, "echo", 1, secret=b"s", timeout=30.0)
+        assert time.monotonic() - t0 < 15.0
     finally:
         server.shutdown()
 
@@ -109,6 +166,48 @@ def test_host_trials_unreachable_worker_fails_trials_not_sweep(two_workers):
     failed = [t for t in trials.trials if t["result"]["status"] == STATUS_FAIL]
     assert len(ok) + len(failed) == 10 and ok and failed
     assert all("worker" in t["result"]["error"] for t in failed)
+
+
+def test_host_trials_all_workers_dead_fails_fast():
+    # Nothing listens on these ports. Every transport attempt drops its
+    # worker; once the live count hits zero the remaining trials must fail
+    # immediately rather than each waiting out rpc_timeout in the pool get.
+    trials = HostTrials(
+        ["127.0.0.1:1", "127.0.0.1:2"], parallelism=2, rpc_timeout=30.0
+    )
+    t0 = time.monotonic()
+    fmin(
+        "dss_ml_at_scale_tpu.hpo.objectives:quadratic",
+        {"x": hp.uniform("x", -10, 10)},
+        max_evals=12,
+        trials=trials,
+        rstate=np.random.default_rng(4),
+        return_argmin=False,
+    )
+    elapsed = time.monotonic() - t0
+    assert len(trials.trials) == 12
+    assert all(t["result"]["status"] == STATUS_FAIL for t in trials.trials)
+    # 12 trials × 30 s timeout would be 360 s serialized; fail-fast keeps
+    # the whole sweep well under one timeout's worth.
+    assert elapsed < 25.0, f"sweep stalled {elapsed:.1f}s after pool death"
+
+
+def test_host_trials_authenticated_worker():
+    server = serve_trial_worker(block=False, secret=b"hmac-secret")
+    addr = f"{server.address[0]}:{server.address[1]}"
+    try:
+        trials = HostTrials([addr], secret=b"hmac-secret")
+        best = fmin(
+            "dss_ml_at_scale_tpu.hpo.objectives:quadratic",
+            {"x": hp.uniform("x", -10, 10)},
+            max_evals=6,
+            trials=trials,
+            rstate=np.random.default_rng(5),
+        )
+        assert all(t["result"]["status"] == STATUS_OK for t in trials.trials)
+        assert "x" in best
+    finally:
+        server.shutdown()
 
 
 # -- real worker process via the CLI ---------------------------------------

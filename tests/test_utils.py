@@ -42,6 +42,24 @@ def test_step_timer_capacity_bounded():
     assert len(t.intervals) == 10
 
 
+def test_step_timer_skips_compile_interval_at_recorder():
+    # The first interval after construction (the compile step) is dropped
+    # when recorded, so later ring-buffer eviction can't resurrect it and
+    # per-epoch resets don't silently discard a real step.
+    t = StepTimer()
+    for _ in range(4):
+        t.tick()
+    assert len(t.intervals) == 2  # 3 intervals ticked, compile one dropped
+    t.reset()  # epoch >= 2: no compile step, nothing skipped
+    for _ in range(4):
+        t.tick()
+    assert len(t.intervals) == 3
+    t.reset(skip_next_interval=True)  # caller knows a recompile is coming
+    for _ in range(4):
+        t.tick()
+    assert len(t.intervals) == 2
+
+
 def test_trace_writes_profile(tmp_path):
     logdir = tmp_path / "trace"
     with trace(str(logdir)):
