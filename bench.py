@@ -1,7 +1,16 @@
 """Headline benchmark: ResNet-50 training throughput, images/sec/chip.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+``value`` is compute-path images/sec/chip on synthetic device-resident
+batches. The ``pipeline`` sub-object holds the number the reference's
+track A is actually about (``deep_learning/2.distributed-data-loading-
+petastorm.py:246-259,338``): end-to-end images/sec when the same train
+step is fed by the real input pipeline — a Delta table of JPEGs streamed
+through the sharded Parquet reader, the native decode pool, and
+host→device prefetch — plus the input-stall fraction
+(1 − e2e/compute; 0.0 means the chip never waits on input).
 
 The reference publishes no numbers (BASELINE.md); the operative target is
 the driver-defined north star — ResNet-50 images/sec/chip vs an
@@ -9,53 +18,272 @@ the driver-defined north star — ResNet-50 images/sec/chip vs an
 by A100_IMG_PER_SEC (a public ~A100 ResNet-50 mixed-precision per-GPU
 figure), so 1.0 == per-chip parity with the reference-class hardware.
 
-Runs on whatever jax.devices() provides: the real TPU chip under the
-driver, or (fallback) CPU where the number is meaningless but the
-harness still exercises end to end.
+Harness discipline: this process NEVER exits non-zero and always prints
+exactly one JSON line. The accelerator backend lives behind a remote
+tunnel that has been observed to both *fail* transiently and *hang
+indefinitely* in ``jax.devices()`` — so the measurement runs in a
+watchdog subprocess with a hard timeout, retried once, then falls back
+to a forced-CPU subprocess with the failure recorded in ``note`` — a
+meaningless number with a diagnosis beats a crash or a stall.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
+import time
+import traceback
 
 A100_IMG_PER_SEC = 2500.0  # ResNet-50 train, mixed precision, per A100
 
+_CHILD_ENV = "DSST_BENCH_CHILD"
+_FORCE_CPU_ENV = "DSST_BENCH_FORCE_CPU"
+_TIMEOUT_ENV = "DSST_BENCH_TIMEOUT"  # seconds per child attempt
 
-def main() -> None:
-    import jax
 
+# ---------------------------------------------------------------------------
+# Parent: watchdog around a child process that does the real work
+# ---------------------------------------------------------------------------
+
+def parent_main() -> None:
+    timeout = float(os.environ.get(_TIMEOUT_ENV, "480"))
+    notes: list[str] = []
+
+    def run_child(force_cpu: bool, t: float):
+        env = dict(os.environ, **{_CHILD_ENV: "1"})
+        if force_cpu:
+            env[_FORCE_CPU_ENV] = "1"
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, timeout=t, capture_output=True, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            return None, f"timed out after {t:.0f}s (backend hang?)"
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    if parsed.get("failed"):
+                        # The child completed but measured nothing (e.g. a
+                        # transient backend error it caught): report it as a
+                        # failure so the retry / CPU fallback still runs.
+                        note = str(parsed.get("note", ""))[-300:]
+                        return None, f"child failed: {note}"
+                    return parsed, None
+            except json.JSONDecodeError:
+                continue
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+        return None, f"rc={proc.returncode}, no JSON line; tail: {' | '.join(tail)}"
+
+    for attempt in (1, 2):
+        result, err = run_child(force_cpu=False, t=timeout)
+        if result is not None:
+            _emit(result, notes)
+            return
+        notes.append(f"accelerator attempt {attempt}: {err}")
+        if attempt == 1:
+            time.sleep(5.0)  # transient-failure cooldown between attempts
+
+    result, err = run_child(force_cpu=True, t=min(timeout, 300.0))
+    if result is not None:
+        notes.append("fell back to cpu — number is a harness check only")
+        _emit(result, notes)
+        return
+    notes.append(f"cpu fallback: {err}")
+    _emit(
+        {
+            "metric": "resnet50_train_images_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "images/sec",
+            "vs_baseline": 0.0,
+        },
+        notes,
+    )
+
+
+def _emit(result: dict, notes: list[str]) -> None:
+    if notes:
+        prior = result.get("note")
+        result["note"] = "; ".join(([prior] if prior else []) + notes)
+    print(json.dumps(result))
+
+
+# ---------------------------------------------------------------------------
+# Child: the actual measurement
+# ---------------------------------------------------------------------------
+
+def _chw(batch):
+    """Benchmark batches in CHW to match the reader's field contract, so
+    the compute phase and the pipeline phase share one compiled step."""
+    import numpy as np
+
+    return {
+        "image": np.ascontiguousarray(np.transpose(batch["image"], (0, 3, 1, 2))),
+        "label": batch["label"],
+    }
+
+
+def _bench_compute(jax, task, batch_size: int, image: int, steps: int):
+    """Compute-only images/sec: synthetic batch already resident in HBM."""
     from dss_ml_at_scale_tpu.utils.benchlib import (
-        build_resnet_task,
         synthetic_image_batch,
         timed_train_steps,
     )
 
-    on_accel = jax.devices()[0].platform != "cpu"
-    # Reference per-rank batch is 212 (deep_learning/2...py:342); bf16
-    # ResNet-50 at 212×224×224 fits a v5e chip.
-    batch = 212 if on_accel else 8
-    image = 224 if on_accel else 64
-    steps = 10 if on_accel else 2
-
-    task = build_resnet_task(num_classes=1000, on_accel=on_accel)
-    host_batch = synthetic_image_batch(batch, image, num_classes=1000)
+    host_batch = _chw(synthetic_image_batch(batch_size, image, num_classes=1000))
     state = task.init_state(jax.random.key(0), host_batch)
     device_batch = jax.device_put(host_batch)
     train_step = jax.jit(task.train_step, donate_argnums=0)
-
     _, dt = timed_train_steps(train_step, state, device_batch, steps)
-    ips = batch * steps / dt
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_images_per_sec_per_chip",
-                "value": round(ips, 2),
-                "unit": f"images/sec (batch {batch}, {jax.devices()[0].device_kind})",
-                "vs_baseline": round(ips / A100_IMG_PER_SEC, 4),
-            }
-        )
+    return train_step, batch_size * steps / dt
+
+
+def _write_jpeg_table(path, *, n_images: int, source_size: int, seed: int = 0):
+    """Synthetic JPEG Delta table shaped like the reference's ImageNet
+    ingest (binary ``content`` + int ``label_index``, R1/`1.data-preparation.py`)."""
+    import io
+
+    import numpy as np
+    import pyarrow as pa
+    from PIL import Image
+
+    from dss_ml_at_scale_tpu.data import write_delta
+
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 1000, n_images)
+    jpegs = []
+    # Blocky low-frequency content: realistic JPEG entropy (pure noise
+    # inflates decode cost; flat color deflates it).
+    for _ in range(n_images):
+        blocks = rng.uniform(0, 255, (8, 8, 3))
+        img = np.kron(blocks, np.ones((source_size // 8, source_size // 8, 1)))
+        buf = io.BytesIO()
+        Image.fromarray(img.astype(np.uint8)).save(buf, format="JPEG", quality=85)
+        jpegs.append(buf.getvalue())
+    table = pa.table(
+        {
+            "content": pa.array(jpegs, type=pa.binary()),
+            "label_index": pa.array(labels.astype(np.int64)),
+        }
     )
+    write_delta(table, path, max_rows_per_file=max(16, n_images // 16))
+    return path
+
+
+def _bench_pipeline(jax, train_step, task, *, batch_size: int, image: int,
+                    source_size: int, steps: int, workers: int, tmpdir: str):
+    """End-to-end images/sec: Delta table → sharded reader → decode pool →
+    prefetch → the SAME compiled train step as the compute phase."""
+    from pathlib import Path
+
+    from dss_ml_at_scale_tpu.data import batch_loader
+    from dss_ml_at_scale_tpu.data.prefetch import prefetch_to_devices
+    from dss_ml_at_scale_tpu.data.transform import imagenet_transform_spec
+    from dss_ml_at_scale_tpu.utils.benchlib import synthetic_image_batch
+
+    n_images = max(4 * batch_size, 256)
+    table_path = _write_jpeg_table(
+        Path(tmpdir) / "bench_imagenet",
+        n_images=n_images,
+        source_size=source_size,
+    )
+    spec = imagenet_transform_spec(resize=image + image // 8, crop=image)
+    state = task.init_state(
+        jax.random.key(0),
+        _chw(synthetic_image_batch(batch_size, image, num_classes=1000)),
+    )
+    with batch_loader(
+        table_path,
+        batch_size=batch_size,
+        num_epochs=None,  # infinite stream; the step count draws the window
+        workers_count=workers,
+        results_queue_size=8,
+        transform_spec=spec,
+    ) as reader:
+        batches = prefetch_to_devices(iter(reader), depth=2)
+        for _ in range(2):  # warmup: fill prefetch + first dispatch
+            state, metrics = train_step(state, next(batches))
+        float(metrics["train_loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = train_step(state, next(batches))
+        float(metrics["train_loss"])
+        dt = time.perf_counter() - t0
+    return batch_size * steps / dt
+
+
+def child_main() -> None:
+    result = {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "images/sec",
+        "vs_baseline": 0.0,
+    }
+    try:
+        import jax
+
+        if os.environ.get(_FORCE_CPU_ENV):
+            # Env-var JAX_PLATFORMS is overridden by the accelerator plugin
+            # in this image; the in-process config update is what sticks.
+            jax.config.update("jax_platforms", "cpu")
+
+        platform = jax.devices()[0].platform
+        on_accel = platform != "cpu"
+        result["platform"] = platform
+        result["device"] = jax.devices()[0].device_kind
+
+        from dss_ml_at_scale_tpu.utils.benchlib import build_resnet_task
+
+        # Reference per-rank batch is 212 (deep_learning/2...py:342); bf16
+        # ResNet-50 at 212×224×224 fits a v5e chip.
+        batch = 212 if on_accel else 8
+        image = 224 if on_accel else 64
+        steps = 10 if on_accel else 2
+
+        task = build_resnet_task(num_classes=1000, on_accel=on_accel)
+        train_step, ips = _bench_compute(jax, task, batch, image, steps)
+        result.update(
+            value=round(ips, 2),
+            unit=f"images/sec (batch {batch}, {jax.devices()[0].device_kind})",
+            vs_baseline=round(ips / A100_IMG_PER_SEC, 4),
+        )
+
+        # -- end-to-end input pipeline (the track-A thesis) -----------------
+        import tempfile
+
+        try:
+            workers = min(8, os.cpu_count() or 2)
+            with tempfile.TemporaryDirectory() as tmpdir:
+                e2e_ips = _bench_pipeline(
+                    jax, train_step, task,
+                    batch_size=batch, image=image,
+                    source_size=image + image // 4,
+                    steps=steps, workers=workers, tmpdir=tmpdir,
+                )
+            result["pipeline"] = {
+                "e2e_images_per_sec": round(e2e_ips, 2),
+                "input_stall_fraction": round(max(0.0, 1.0 - e2e_ips / ips), 4)
+                if ips > 0 else None,
+                "step_time_ratio_vs_synthetic": round(ips / e2e_ips, 4)
+                if e2e_ips > 0 else None,
+                "reader_workers": workers,
+                "host_cores": os.cpu_count(),
+            }
+        except Exception:
+            result["pipeline"] = {"error": traceback.format_exc(limit=5)}
+    except Exception:
+        note = traceback.format_exc(limit=5)
+        result["note"] = (result.get("note", "") + " | " + note).strip(" |")
+        result["failed"] = True  # tells the parent to retry / fall back
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get(_CHILD_ENV):
+        child_main()
+    else:
+        parent_main()
+    sys.exit(0)
