@@ -22,6 +22,36 @@ def brittle_quadratic(args) -> float:
     return (args["x"] - 3.0) ** 2
 
 
+def group_pid_summary(group):
+    """Per-group demo fn for ``group_apply(executor="process")``.
+
+    Deliberately GIL-bound (pure-Python loop, a stand-in for a
+    statsmodels-style fit) and reports the worker ``pid`` so tests can
+    assert the group genuinely ran out-of-process.
+    """
+    import os
+
+    import pandas as pd
+
+    acc = 0.0
+    for i in range(50_000):
+        acc += (i % 7) * 0.5
+    return pd.DataFrame(
+        {
+            "SKU": [group["SKU"].iloc[0]],
+            "mean": [float(group["Demand"].mean())],
+            "pid": [os.getpid()],
+        }
+    )
+
+
+def brittle_group_head(group):
+    """Group fn that raises for one SKU — per-group failure-isolation probe."""
+    if group["SKU"].iloc[0] == "SKU2":
+        raise RuntimeError("group blew up")
+    return group.head(1)[["SKU"]]
+
+
 def lasso_shared(args) -> dict:
     """Lasso fit against a shared-FS dataset (the ≥1 GB shipping regime).
 

@@ -9,7 +9,11 @@ TPU-native execution paths replace that:
 1. :func:`group_apply` — the **host path**: groups hash-sharded across
    processes (multi-host) and a worker pool within each process. Runs
    any Python function per group, exactly like ``applyInPandas``; this
-   is the compatibility surface.
+   is the compatibility surface. ``executor="process"`` runs each group
+   in a subprocess pool — the reference's actual execution shape (one
+   Python worker process per Spark task) and the right choice for
+   GIL-bound pure-Python group functions; it requires ``fn`` to be
+   importable by reference, the same contract as remote HPO objectives.
 2. :func:`pad_groups` + :func:`device_put_groups` + :func:`batched_fmin`
    — the **device path**: groups padded to a rectangle, stacked, sharded
    over a ``Mesh`` axis, and fitted by ONE ``vmap``-compiled program.
@@ -22,7 +26,7 @@ TPU-native execution paths replace that:
 from __future__ import annotations
 
 import hashlib
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Callable, NamedTuple, Sequence
 
 import numpy as np
@@ -41,6 +45,31 @@ def shard_of(key: tuple, process_count: int) -> int:
     return stable_group_hash(key) % process_count
 
 
+def _run_group_by_ref(args):
+    """Subprocess worker: resolve ``fn`` by module:qualname and run it.
+
+    Module-level so it pickles by reference into pool workers; the group
+    frame ships pickled, the function ships as a name — the moral
+    equivalent of Spark sending Arrow batches to Python worker processes.
+    The ref resolves with a plain importlib lookup (not
+    ``trials.resolve_objective``) so spawn workers don't also pay the
+    jax-importing ``trials``/``hpo.fmin`` module chain.
+    """
+    ref, group, on_error = args
+    import importlib
+
+    module, _, qualname = ref.partition(":")
+    fn = importlib.import_module(module)
+    for part in qualname.split("."):
+        fn = getattr(fn, part)
+    try:
+        return fn(group)
+    except Exception:
+        if on_error == "raise":
+            raise
+        return None
+
+
 def group_apply(
     df: pd.DataFrame,
     keys: str | Sequence[str],
@@ -50,6 +79,7 @@ def group_apply(
     process_index: int = 0,
     process_count: int = 1,
     on_error: str = "raise",
+    executor: str = "thread",
 ) -> pd.DataFrame:
     """Apply ``fn`` to each key-group of ``df``; concat the results.
 
@@ -58,9 +88,19 @@ def group_apply(
     outputs (or write them to a common Parquet dataset, the usual sink).
     ``on_error='skip'`` gives SparkTrials-style per-group failure
     isolation: a failing group is dropped, the rest proceed.
+
+    ``executor``: ``"thread"`` (default — right for fns that release the
+    GIL, e.g. anything calling jitted kernels or numpy), ``"process"``
+    (one subprocess per worker — right for GIL-bound pure-Python fns;
+    requires ``fn`` importable by reference, like remote HPO objectives),
+    or ``"inline"`` (sequential, for debugging).
     """
     if on_error not in ("raise", "skip"):
         raise ValueError(f"on_error must be 'raise' or 'skip', got {on_error!r}")
+    if executor not in ("thread", "process", "inline"):
+        raise ValueError(
+            f"executor must be 'thread', 'process', or 'inline', got {executor!r}"
+        )
     keys = [keys] if isinstance(keys, str) else list(keys)
     groups = [
         (k if isinstance(k, tuple) else (k,), g)
@@ -77,7 +117,23 @@ def group_apply(
                 raise
             return None
 
-    if num_workers is None or num_workers > 1:
+    if executor == "process":
+        import multiprocessing
+
+        from .trials import objective_ref
+
+        ref = objective_ref(fn)  # raises early on closures/lambdas
+        work = [(ref, g.reset_index(drop=True), on_error) for _, g in mine]
+        # spawn, not fork: the caller has usually initialized JAX/XLA by
+        # now, and forking a process whose runtime threads may hold locks
+        # can deadlock the child. Spawned workers persist across groups,
+        # amortizing their interpreter startup.
+        with ProcessPoolExecutor(
+            max_workers=num_workers,
+            mp_context=multiprocessing.get_context("spawn"),
+        ) as pool:
+            outs = list(pool.map(_run_group_by_ref, work))
+    elif executor == "thread" and (num_workers is None or num_workers > 1):
         with ThreadPoolExecutor(max_workers=num_workers) as pool:
             outs = list(pool.map(run, mine))
     else:
