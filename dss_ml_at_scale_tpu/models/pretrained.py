@@ -1,0 +1,149 @@
+"""Torchvision-layout ResNet weights → Flax param tree.
+
+The reference fine-tunes torchvision's pretrained
+``resnet50(weights="IMAGENET1K_V2")`` (reference
+``deep_learning/2.distributed-data-loading-petastorm.py:150``). This
+module loads publicly-published weights in that layout — a torch
+``state_dict`` (.pt/.pth) or an .npz with the same key names — into
+:class:`~dss_ml_at_scale_tpu.models.resnet.ResNet`, so ``dsst train
+--pretrained <path>`` fine-tunes instead of cold-starting.
+
+Layout mapping (torchvision → this repo's Flax ResNet):
+
+==========================  =======================================
+``conv1.weight``            ``conv_init/kernel`` (OIHW → HWIO)
+``bn1.weight/bias``         ``norm_init/scale|bias``
+``bn1.running_mean/var``    batch_stats ``norm_init/mean|var``
+``layerL.i.convK.weight``   ``<Block>_n/Conv_{K-1}/kernel``
+``layerL.i.bnK.*``          ``<Block>_n/BatchNorm_{K-1}/*``
+``layerL.i.downsample.0``   ``<Block>_n/conv_proj``
+``layerL.i.downsample.1``   ``<Block>_n/norm_proj``
+``fc.weight/bias``          ``Dense_0/kernel`` (transposed) ``|bias``
+==========================  =======================================
+
+with ``n = sum(stage_sizes[:L-1]) + i`` (Flax auto-numbers blocks
+globally, torchvision per stage). Load with ``torch_padding=True`` on
+the model — torchvision pads stride-2 convs symmetrically where XLA's
+SAME does not, and the running BatchNorm statistics embed that choice.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Mapping
+
+import numpy as np
+
+
+def _to_numpy(v) -> np.ndarray:
+    if hasattr(v, "detach"):  # torch.Tensor without importing torch here
+        v = v.detach().cpu().numpy()
+    return np.asarray(v)
+
+
+def load_state_dict(path: str | Path) -> dict[str, np.ndarray]:
+    """Read a torchvision-layout state dict from .pt/.pth (torch) or .npz."""
+    path = Path(path)
+    if path.suffix == ".npz":
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    import torch
+
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(state, Mapping) and "state_dict" in state:
+        state = state["state_dict"]
+    return {k: _to_numpy(v) for k, v in state.items()}
+
+
+def _torch_name(path: tuple[str, ...], stage_sizes) -> tuple[str, str]:
+    """(flax collection path) → (torch key, transform tag)."""
+    col, *rest = path
+    bounds = np.cumsum([0, *stage_sizes])
+
+    def block_pos(name: str) -> tuple[int, int]:
+        n = int(name.rsplit("_", 1)[1])
+        layer = int(np.searchsorted(bounds, n, side="right"))  # 1-based
+        return layer, n - int(bounds[layer - 1])
+
+    if rest[0] == "conv_init":
+        return "conv1.weight", "conv"
+    if rest[0] == "norm_init":
+        return f"bn1.{_bn_leaf(col, rest[-1])}", "none"
+    if rest[0] == "Dense_0":
+        return ("fc.weight", "dense") if rest[1] == "kernel" else ("fc.bias", "none")
+    # Block-level parameters.
+    layer, i = block_pos(rest[0])
+    inner, leaf = rest[1], rest[-1]
+    prefix = f"layer{layer}.{i}"
+    if inner.startswith("Conv_"):
+        return f"{prefix}.conv{int(inner[5:]) + 1}.weight", "conv"
+    if inner.startswith("BatchNorm_"):
+        return f"{prefix}.bn{int(inner[10:]) + 1}.{_bn_leaf(col, leaf)}", "none"
+    if inner == "conv_proj":
+        return f"{prefix}.downsample.0.weight", "conv"
+    if inner == "norm_proj":
+        return f"{prefix}.downsample.1.{_bn_leaf(col, leaf)}", "none"
+    raise KeyError(f"no torchvision mapping for flax path {path}")
+
+
+def _bn_leaf(collection: str, leaf: str) -> str:
+    if collection == "batch_stats":
+        return {"mean": "running_mean", "var": "running_var"}[leaf]
+    return {"scale": "weight", "bias": "bias"}[leaf]
+
+
+_TRANSFORMS = {
+    "conv": lambda a: np.transpose(a, (2, 3, 1, 0)),  # OIHW -> HWIO
+    "dense": lambda a: np.transpose(a, (1, 0)),  # [out,in] -> [in,out]
+    "none": lambda a: a,
+}
+
+
+def convert_torchvision_resnet(
+    state: Mapping[str, Any],
+    variables: Mapping[str, Any],
+    stage_sizes,
+) -> dict:
+    """Fill a model's ``variables`` template from a torchvision state dict.
+
+    Template-guided: every leaf of ``variables`` (from ``model.init``)
+    must find its torch tensor with the right shape after transform;
+    extra torch keys (e.g. ``num_batches_tracked``) are ignored.
+    """
+    import jax
+
+    state = {k: _to_numpy(v) for k, v in state.items()}
+
+    def fill(path, leaf):
+        keys = tuple(getattr(p, "key", getattr(p, "name", str(p))) for p in path)
+        torch_key, tag = _torch_name(keys, stage_sizes)
+        if torch_key not in state:
+            raise KeyError(
+                f"pretrained state has no {torch_key!r} (for flax {keys})"
+            )
+        arr = _TRANSFORMS[tag](state[torch_key])
+        if arr.shape != leaf.shape:
+            raise ValueError(
+                f"{torch_key}: shape {arr.shape} != model {leaf.shape} "
+                f"(flax {keys})"
+            )
+        return np.asarray(arr, dtype=np.asarray(leaf).dtype)
+
+    return jax.tree_util.tree_map_with_path(fill, dict(variables))
+
+
+def load_pretrained_resnet(path: str | Path, model, image_size: int = 224):
+    """Path → converted ``{"params", "batch_stats"}`` for ``model``.
+
+    ``model`` should be built with ``torch_padding=True`` for exact
+    torchvision numerics (see module docstring).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    template = model.init(
+        jax.random.key(0), jnp.zeros((1, image_size, image_size, 3)), train=False
+    )
+    return convert_torchvision_resnet(
+        load_state_dict(path), template, model.stage_sizes
+    )

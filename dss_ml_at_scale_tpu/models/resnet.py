@@ -89,12 +89,25 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     act: Callable = nn.relu
+    # torchvision pads stride-2 convs symmetrically ((k-1)//2 each side)
+    # where XLA's SAME pads asymmetrically on even inputs. Irrelevant when
+    # training from scratch; REQUIRED for numerical parity when loading
+    # torchvision-layout pretrained weights (models/pretrained.py).
+    torch_padding: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        conv = functools.partial(
-            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
-        )
+        if self.torch_padding:
+            def conv(features, kernel_size, strides=(1, 1), **kw):
+                pad = tuple(((k - 1) // 2, (k - 1) // 2) for k in kernel_size)
+                return nn.Conv(
+                    features, kernel_size, strides, padding=pad,
+                    use_bias=False, dtype=self.dtype, **kw,
+                )
+        else:
+            conv = functools.partial(
+                nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
+            )
         norm = functools.partial(
             nn.BatchNorm,
             use_running_average=not train,
@@ -106,7 +119,10 @@ class ResNet(nn.Module):
         x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="norm_init")(x)
         x = self.act(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.max_pool(
+            x, (3, 3), strides=(2, 2),
+            padding=((1, 1), (1, 1)) if self.torch_padding else "SAME",
+        )
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1

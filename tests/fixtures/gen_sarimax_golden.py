@@ -1,0 +1,280 @@
+"""Generate golden fixtures for the JAX SARIMAX kernels.
+
+An INDEPENDENT plain-NumPy/SciPy implementation of the same model —
+explicit Python loops, unpadded dimensions, scipy Lyapunov solve — serves
+as the oracle (statsmodels is not installed in this image; SURVEY.md §7
+names numerical parity the riskiest target, reference
+``group_apply/02_Fine_Grained_Demand_Forecasting.py:226-230,441-494``).
+
+Writes ``sarimax_golden.json`` with, per (p,d,q) grid-corner case:
+pinned parameter values, the oracle's exact log-likelihood and full-range
+predictions at those params, and the oracle's best achieved likelihood
+from a scipy Nelder-Mead fit on the UNPADDED parameterization (an easier
+optimization problem than the padded one the JAX fit solves, so it is a
+fair quality bar).
+
+Model (shared by both implementations):
+    y_t = x_t' beta + u_t,   Delta^d u_t ~ ARMA(p, q), innovation var
+    sigma2; Harvey state space, exact Kalman likelihood over
+    t in [d, n_valid); stationary Lyapunov initialization with an
+    approximate-diffuse fallback (kappa = 1e4 * max(sigma2, 1)).
+
+Run from the repo root:  python tests/fixtures/gen_sarimax_golden.py
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+from scipy import linalg, optimize
+
+KAPPA = 1e4
+LOG2PI = float(np.log(2 * np.pi))
+
+
+# ---------------------------------------------------------------------------
+# Oracle: plain-NumPy SARIMAX (unpadded, loop-based — independent of ops/)
+# ---------------------------------------------------------------------------
+
+def difference(x: np.ndarray, d: int) -> np.ndarray:
+    """Delta^d with the first d entries zeroed (invalid)."""
+    w = np.zeros_like(x)
+    if d == 0:
+        return x.copy()
+    if d == 1:
+        w[1:] = x[1:] - x[:-1]
+        return w
+    if d == 2:
+        w[2:] = x[2:] - 2 * x[1:-1] + x[:-2]
+        return w
+    raise ValueError(d)
+
+
+def harvey_matrices(phi: np.ndarray, theta: np.ndarray, sigma2: float):
+    p, q = len(phi), len(theta)
+    r = max(p, q + 1, 1)
+    T = np.zeros((r, r))
+    T[:p, 0] = phi
+    T[: r - 1, 1:] += np.eye(r - 1)
+    R = np.zeros((r, 1))
+    R[0, 0] = 1.0
+    R[1 : 1 + q, 0] = theta
+    Q = np.array([[sigma2]])
+    Z = np.zeros(r)
+    Z[0] = 1.0
+    return T, R, Q, Z
+
+
+def init_cov(T, R, Q, sigma2: float):
+    """Stationary Lyapunov solve; approximate-diffuse fallback."""
+    RQR = R @ Q @ R.T
+    kappa = KAPPA * max(sigma2, 1.0)
+    r = T.shape[0]
+    try:
+        P = linalg.solve_discrete_lyapunov(T, RQR)
+        P = 0.5 * (P + P.T)
+        ok = (
+            np.all(np.isfinite(P))
+            and np.all(np.diag(P) >= -1e-6)
+            and np.max(np.abs(P)) < kappa
+        )
+    except Exception:
+        ok = False
+    if not ok:
+        P = kappa * np.eye(r)
+    return P
+
+
+def oracle_filter(y, exog, beta, phi, theta, sigma2, d, n_valid):
+    """Loglike + one-step/multi-step prediction means, model semantics.
+
+    Runs over ALL n timesteps from t=0 (masked steps t<d and t>=n_valid
+    do prediction-only propagation), matching the model's definition of
+    in-sample one-step-ahead and beyond-sample dynamic prediction.
+    """
+    y = np.asarray(y, float)
+    exog = np.asarray(exog, float)
+    n = len(y)
+    resid = y - (exog @ beta if len(beta) else 0.0)
+    w = difference(resid, d)
+    T, R, Q, Z = harvey_matrices(np.asarray(phi), np.asarray(theta), sigma2)
+    P = init_cov(T, R, Q, sigma2)
+    a = np.zeros(T.shape[0])
+    RQR = R @ Q @ R.T
+
+    ll = 0.0
+    w_hat = np.zeros(n)
+    for t in range(n):
+        a_pred = T @ a
+        P_pred = T @ P @ T.T + RQR
+        w_hat[t] = Z @ a_pred
+        valid = d <= t < n_valid
+        if valid:
+            v = w[t] - Z @ a_pred
+            F = max(float(Z @ P_pred @ Z), 1e-12)
+            ll += -0.5 * (LOG2PI + np.log(F) + v * v / F)
+            K = P_pred @ Z / F
+            a = a_pred + K * v
+            P = P_pred - np.outer(K, Z @ P_pred)
+        else:
+            a, P = a_pred, P_pred
+        P = 0.5 * (P + P.T)
+
+    # Undifference into full-range predictions of y.
+    r_pred = np.zeros(n)
+    rm1 = rm2 = 0.0
+    for t in range(n):
+        if d == 1:
+            lag = rm1
+        elif d == 2:
+            lag = 2 * rm1 - rm2
+        else:
+            lag = 0.0
+        pred = resid[t] if t < d else w_hat[t] + lag
+        r_t = resid[t] if t < n_valid else pred
+        rm2, rm1 = rm1, r_t
+        r_pred[t] = pred
+    xb = exog @ beta if len(beta) else np.zeros(n)
+    return ll, xb + r_pred
+
+
+def oracle_fit(y, exog, order, n_valid, restarts: int = 3):
+    """Best loglike from scipy Nelder-Mead on the UNPADDED params."""
+    p, d, q = order
+    y = np.asarray(y, float)
+    exog = np.asarray(exog, float)
+    k = exog.shape[1]
+    obs = (np.arange(len(y)) < n_valid).astype(float)
+    Xw = exog * obs[:, None]
+    beta0 = np.linalg.solve(Xw.T @ exog + 1e-3 * np.eye(k), Xw.T @ y)
+    w = difference(y - exog @ beta0, d)
+    wm = w[d:n_valid]
+    var0 = max(wm.var(), 1e-8)
+    x0 = np.concatenate([beta0, np.zeros(p + q), [np.log(var0)]])
+
+    def nll(params):
+        beta = params[:k]
+        phi = params[k : k + p]
+        theta = params[k + p : k + p + q]
+        sigma2 = float(np.exp(np.clip(params[-1], -30, 30)))
+        ll, _ = oracle_filter(y, exog, beta, phi, theta, sigma2, d, n_valid)
+        return -ll if np.isfinite(ll) else 1e12
+
+    best = None
+    rng = np.random.default_rng(0)
+    starts = [x0] + [x0 + rng.normal(0, 0.1, len(x0)) for _ in range(restarts - 1)]
+    for s in starts:
+        res = optimize.minimize(
+            nll, s, method="Nelder-Mead",
+            options={"maxiter": 4000, "xatol": 1e-6, "fatol": 1e-8},
+        )
+        # Polish with a restarted simplex around the incumbent.
+        res = optimize.minimize(
+            nll, res.x, method="Nelder-Mead",
+            options={"maxiter": 4000, "xatol": 1e-6, "fatol": 1e-8},
+        )
+        if best is None or res.fun < best.fun:
+            best = res
+    return -float(best.fun), best.x
+
+
+# ---------------------------------------------------------------------------
+# Fixture construction
+# ---------------------------------------------------------------------------
+
+def make_series(n: int = 165, n_valid: int = 157, seed: int = 42):
+    """ARMAX series at EDA scale: ~157 weekly points + 8-step horizon,
+    exogenous step/seasonal flags like the reference's covid/christmas."""
+    rng = np.random.default_rng(seed)
+    # exog: step (covid-like), short seasonal pulse, ramp
+    step = (np.arange(n) >= 40).astype(float)
+    pulse = (np.arange(n) % 52 < 2).astype(float)
+    ramp = np.arange(n) / n
+    exog = np.stack([step, pulse, ramp], axis=1)
+    beta_true = np.array([5.0, -3.0, 8.0])
+    # ARMA(2,1) innovations, then single integration for trend-like level.
+    eps = rng.normal(0, 1.0, n + 50)
+    arma = np.zeros(n + 50)
+    for t in range(2, n + 50):
+        arma[t] = 0.55 * arma[t - 1] - 0.2 * arma[t - 2] + eps[t] + 0.3 * eps[t - 1]
+    u = np.cumsum(arma[50:])  # d=1 integrated
+    y = exog @ beta_true + 30.0 + 0.1 * u
+    return y, exog, n_valid
+
+
+# Pinned (unpadded) parameter points: clearly stationary so both
+# implementations take the Lyapunov branch; one explosive case (d=0)
+# pins the approximate-diffuse branch.
+PHI_POOL = [0.5, -0.3, 0.2, 0.1]
+THETA_POOL = [0.4, -0.25, 0.15, 0.1]
+BETA = [4.0, -2.0, 6.0]
+LOG_S2 = float(np.log(1.3))
+
+GRID_ORDERS = [
+    (0, 0, 0), (1, 0, 1), (4, 0, 0), (0, 0, 4), (4, 0, 4),
+    (2, 1, 2), (4, 1, 4), (1, 2, 1), (0, 2, 4), (4, 2, 4),
+]
+FIT_ORDERS = [(1, 1, 1), (2, 1, 2), (4, 2, 4), (4, 0, 4), (0, 2, 4)]
+
+
+def main() -> None:
+    y, exog, n_valid = make_series()
+    cases = []
+    for (p, d, q) in GRID_ORDERS:
+        phi, theta = PHI_POOL[:p], THETA_POOL[:q]
+        ll, pred = oracle_filter(
+            y, exog, np.array(BETA), np.array(phi), np.array(theta),
+            float(np.exp(LOG_S2)), d, n_valid,
+        )
+        cases.append(
+            {
+                "order": [p, d, q],
+                "beta": BETA,
+                "phi": phi,
+                "theta": theta,
+                "log_sigma2": LOG_S2,
+                "loglike": ll,
+                "predict": pred.tolist(),
+            }
+        )
+    # Diffuse-initialization pin: explosive AR(1), d=0.
+    ll, pred = oracle_filter(
+        y, exog, np.array(BETA), np.array([1.3]), np.array([]),
+        float(np.exp(LOG_S2)), 0, n_valid,
+    )
+    cases.append(
+        {
+            "order": [1, 0, 0],
+            "beta": BETA,
+            "phi": [1.3],
+            "theta": [],
+            "log_sigma2": LOG_S2,
+            "loglike": ll,
+            "predict": pred.tolist(),
+            "note": "explosive AR root — pins the approximate-diffuse init",
+        }
+    )
+
+    fits = []
+    for order in FIT_ORDERS:
+        ll_best, _ = oracle_fit(y, exog, order, n_valid)
+        fits.append({"order": list(order), "loglike": ll_best})
+        print(f"oracle fit {order}: loglike {ll_best:.4f}")
+
+    out = {
+        "kappa": KAPPA,
+        "n_valid": int(n_valid),
+        "y": y.tolist(),
+        "exog": exog.tolist(),
+        "cases": cases,
+        "fits": fits,
+    }
+    path = Path(__file__).with_name("sarimax_golden.json")
+    path.write_text(json.dumps(out))
+    print(f"wrote {path} ({len(cases)} likelihood cases, {len(fits)} fit bars)")
+
+
+if __name__ == "__main__":
+    main()
