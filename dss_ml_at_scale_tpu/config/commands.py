@@ -278,6 +278,19 @@ def register_train(sub: argparse._SubParsersAction) -> None:
     tr.add_argument("--num-classes", type=int, default=1000)
     tr.add_argument("--crop", type=int, default=224)
     tr.add_argument("--model", choices=["resnet50", "tiny"], default="resnet50")
+    tr.add_argument(
+        "--pretrained", default=None, metavar="PATH",
+        help="torchvision-layout state dict (.pt/.pth/.npz) to fine-tune "
+        "from instead of cold-starting (reference 2...py:150); builds the "
+        "model with torch_padding=True for numerical parity; a head whose "
+        "class count differs from --num-classes is freshly initialized",
+    )
+    tr.add_argument(
+        "--torch-padding", action="store_true", default=None,
+        help="force torchvision-style symmetric stride-2 padding; needed "
+        "when resuming a --pretrained run without re-passing --pretrained "
+        "(the checkpoint's BatchNorm statistics embed the padding choice)",
+    )
     tr.add_argument("--workers", type=int, default=2)
     tr.add_argument("--queue-size", type=int, default=20)
     tr.add_argument("--limit-val-batches", type=int, default=5)
@@ -311,16 +324,58 @@ def _cmd_train(args: argparse.Namespace) -> int:
     table = DeltaTable(args.data)
     rows = table.num_records()
     spec = imagenet_transform_spec(crop=args.crop)
+    # Pretrained torchvision weights embed symmetric stride-2 padding in
+    # their BatchNorm statistics; the model must match (models/pretrained.py).
+    # The choice is persisted next to the checkpoint so a later --resume
+    # that omits both flags still rebuilds the same architecture.
+    meta_path = (
+        Path(args.checkpoint_dir) / "dsst_model.json"
+        if args.checkpoint_dir
+        else None
+    )
+    if args.torch_padding is not None:
+        torch_padding = args.torch_padding
+    elif args.pretrained:
+        torch_padding = True
+    elif meta_path is not None and meta_path.exists():
+        torch_padding = bool(
+            json.loads(meta_path.read_text()).get("torch_padding", False)
+        )
+    else:
+        torch_padding = False
+    if meta_path is not None and topo.process_index == 0:
+        meta_path.parent.mkdir(parents=True, exist_ok=True)
+        meta_path.write_text(
+            json.dumps(
+                {
+                    "torch_padding": torch_padding,
+                    "model": args.model,
+                    "num_classes": args.num_classes,
+                }
+            )
+        )
     if args.model == "resnet50":
-        model = ResNet50(num_classes=args.num_classes)
+        model = ResNet50(num_classes=args.num_classes, torch_padding=torch_padding)
     else:
         from ..models.resnet import ResNet, ResNetBlock
 
         model = ResNet(
             stage_sizes=[1, 1], block_cls=ResNetBlock,
             num_classes=args.num_classes, num_filters=8,
+            torch_padding=torch_padding,
         )
     task = ClassifierTask(model=model, tx=optax.adam(args.learning_rate))
+
+    init_state = None
+    if args.pretrained and not _has_checkpoint(args):
+        # With --resume and an existing checkpoint the restore would
+        # overwrite these weights anyway — skip the conversion.
+        from ..models.pretrained import load_pretrained_resnet
+
+        variables = load_pretrained_resnet(
+            args.pretrained, model, image_size=args.crop
+        )
+        init_state = task.state_from_variables(variables)
 
     tracker = None
     if args.tracking_root:
@@ -365,7 +420,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
         cur_shard=topo.process_index,
         shard_count=topo.process_count,
     ) as train_reader:
-        result = trainer.fit(task, train_reader, val_data_factory=val_factory)
+        result = trainer.fit(
+            task, train_reader, val_data_factory=val_factory, state=init_state
+        )
 
     last = result.history[-1] if result.history else {}
     if tracker is not None:
@@ -383,6 +440,23 @@ def _cmd_train(args: argparse.Namespace) -> int:
         )
     )
     return 0
+
+
+def _has_checkpoint(args: argparse.Namespace) -> bool:
+    """True when --resume will actually restore something — the same
+    orbax ``latest_step()`` predicate Trainer.fit uses, so the two can't
+    disagree about whether a restore will happen."""
+    if not (args.resume and args.checkpoint_dir):
+        return False
+    ckpt = Path(args.checkpoint_dir)
+    if not ckpt.is_dir():
+        return False
+    import orbax.checkpoint as ocp
+
+    try:
+        return ocp.CheckpointManager(ckpt.absolute()).latest_step() is not None
+    except Exception:
+        return False
 
 
 # --------------------------------------------------------------------------

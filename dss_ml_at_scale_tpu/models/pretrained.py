@@ -103,12 +103,19 @@ def convert_torchvision_resnet(
     state: Mapping[str, Any],
     variables: Mapping[str, Any],
     stage_sizes,
+    *,
+    reinit_head: bool = False,
 ) -> dict:
     """Fill a model's ``variables`` template from a torchvision state dict.
 
     Template-guided: every leaf of ``variables`` (from ``model.init``)
     must find its torch tensor with the right shape after transform;
     extra torch keys (e.g. ``num_batches_tracked``) are ignored.
+
+    ``reinit_head=True`` keeps the template's (freshly initialized)
+    classifier head instead of loading ``fc.*`` — the fine-tune-to-new-
+    labels case where the model's class count differs from the
+    checkpoint's.
     """
     import jax
 
@@ -116,6 +123,8 @@ def convert_torchvision_resnet(
 
     def fill(path, leaf):
         keys = tuple(getattr(p, "key", getattr(p, "name", str(p))) for p in path)
+        if reinit_head and keys[1] == "Dense_0":
+            return leaf
         torch_key, tag = _torch_name(keys, stage_sizes)
         if torch_key not in state:
             raise KeyError(
@@ -136,7 +145,9 @@ def load_pretrained_resnet(path: str | Path, model, image_size: int = 224):
     """Path → converted ``{"params", "batch_stats"}`` for ``model``.
 
     ``model`` should be built with ``torch_padding=True`` for exact
-    torchvision numerics (see module docstring).
+    torchvision numerics (see module docstring). When the model's class
+    count differs from the checkpoint's ``fc`` rows, the head is kept at
+    its fresh initialization (backbone-only fine-tune).
     """
     import jax
     import jax.numpy as jnp
@@ -144,6 +155,13 @@ def load_pretrained_resnet(path: str | Path, model, image_size: int = 224):
     template = model.init(
         jax.random.key(0), jnp.zeros((1, image_size, image_size, 3)), train=False
     )
+    state = load_state_dict(path)
+    # Fresh head when the checkpoint can't supply one that fits: class
+    # count differs, or it's a backbone-only export with no fc at all.
+    reinit_head = (
+        "fc.weight" not in state
+        or state["fc.weight"].shape[0] != model.num_classes
+    )
     return convert_torchvision_resnet(
-        load_state_dict(path), template, model.stage_sizes
+        state, template, model.stage_sizes, reinit_head=reinit_head
     )

@@ -86,13 +86,19 @@ class ClassifierTask:
 
     def init_state(self, rng, sample_batch: Batch) -> TrainState:
         images = self._images(sample_batch)
-        variables = self.model.init(rng, images[:1], train=False)
+        return self.state_from_variables(
+            self.model.init(rng, images[:1], train=False)
+        )
+
+    def state_from_variables(self, variables: Mapping[str, Any]) -> TrainState:
+        """TrainState from externally-supplied variables (pretrained
+        weights — reference fine-tunes torchvision IMAGENET1K_V2,
+        ``deep_learning/2...py:150``) with a fresh optimizer."""
         params = variables["params"]
-        batch_stats = variables.get("batch_stats", FrozenDict())
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
-            batch_stats=batch_stats,
+            batch_stats=variables.get("batch_stats", FrozenDict()),
             opt_state=self.tx.init(params),
         )
 

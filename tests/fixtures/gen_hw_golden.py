@@ -1,0 +1,200 @@
+"""Generate golden fixtures for the JAX Holt-Winters kernels.
+
+An INDEPENDENT plain-NumPy oracle — explicit Python-loop recursions, a
+list-rotated seasonal buffer, scipy Box-Cox lambda and scipy bounded
+optimization — pins values for the four variants the reference's EDA
+fits (``group_apply/02_Fine_Grained_Demand_Forecasting.py:143-188``):
+{additive, multiplicative} seasonal x {damped, undamped}, Box-Cox on.
+
+Semantics pinned are the implementation's *declared* semantics
+(``ops/holt_winters.py`` module docstring): heuristic two-season
+initialization (the documented deviation from statsmodels'
+``initialization_method="estimated"``) and SSE-minimized smoothing
+parameters. The oracle implements those same declared semantics
+independently, so recursion/forecast layers can be tight; the fit layer
+is a quality bar (the JAX fit must reach the oracle's SSE within a
+stated slack).
+
+Writes ``hw_golden.json`` with, per variant:
+
+- pinned smoothing-parameter recursion results (fitted values, SSE,
+  final level/trend/season buffer) on the raw scale;
+- h-step forecasts from those final states;
+- the oracle's best achieved SSE from multi-start scipy L-BFGS-B
+  (raw scale, so SSEs are directly comparable);
+- the scipy MLE Box-Cox lambda for the lambda-parity layer.
+
+Run from the repo root:  python tests/fixtures/gen_hw_golden.py
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+from scipy import optimize, stats
+
+
+# ---------------------------------------------------------------------------
+# Oracle: plain-NumPy Holt-Winters (loop-based — independent of ops/)
+# ---------------------------------------------------------------------------
+
+def oracle_init(z: np.ndarray, m: int, seasonal: str):
+    """Two-season heuristic: level/trend from season means, seasonals
+    from the first season's deviation (ratio for multiplicative)."""
+    l0 = float(z[:m].mean())
+    b0 = float((z[m : 2 * m].mean() - z[:m].mean()) / m)
+    if seasonal == "mul":
+        s0 = [float(v) / l0 for v in z[:m]]
+    else:
+        s0 = [float(v) - l0 for v in z[:m]]
+    return l0, b0, s0
+
+
+def oracle_smooth(z, m, alpha, beta, gamma, phi, seasonal):
+    """Run the recursions; returns (fitted, sse, level, trend, season)."""
+    l, b, seas = oracle_init(z, m, seasonal)
+    seas = list(seas)
+    fitted = []
+    for zt in np.asarray(z, float):
+        s_old = seas[0]
+        lb = l + phi * b
+        if seasonal == "mul":
+            f = lb * s_old
+            l_new = alpha * (zt / s_old) + (1 - alpha) * lb
+            s_new = gamma * (zt / lb) + (1 - gamma) * s_old
+        else:
+            f = lb + s_old
+            l_new = alpha * (zt - s_old) + (1 - alpha) * lb
+            s_new = gamma * (zt - lb) + (1 - gamma) * s_old
+        b = beta * (l_new - l) + (1 - beta) * phi * b
+        l = l_new
+        seas = seas[1:] + [s_new]
+        fitted.append(f)
+    fitted = np.asarray(fitted)
+    sse = float(np.sum((np.asarray(z, float) - fitted) ** 2))
+    return fitted, sse, l, b, seas
+
+
+def oracle_forecast(level, trend, season, phi, h_max, seasonal):
+    """h-step-ahead forecasts from final states; damped trend sums phi^j."""
+    out = []
+    for h in range(1, h_max + 1):
+        bsum = sum(phi**j for j in range(1, h + 1))
+        base = level + bsum * trend
+        s = season[(h - 1) % len(season)]
+        out.append(base * s if seasonal == "mul" else base + s)
+    return np.asarray(out)
+
+
+def oracle_fit(z, m, seasonal, damped, restarts: int = 4):
+    """Best SSE over multi-start bounded L-BFGS-B.
+
+    Parameterized as (alpha, beta/alpha, gamma/(1-alpha), phi) — the
+    standard admissible region (beta < alpha, gamma < 1 - alpha).
+    """
+
+    def sse_of(x):
+        alpha, bfrac, gfrac, phi = x
+        beta = bfrac * alpha
+        gamma = gfrac * (1 - alpha)
+        p = phi if damped else 1.0
+        _, sse, *_ = oracle_smooth(z, m, alpha, beta, gamma, p, seasonal)
+        return sse if np.isfinite(sse) else 1e18
+
+    bounds = [(1e-4, 1 - 1e-4)] * 3 + [(0.8, 0.998)]
+    rng = np.random.default_rng(0)
+    starts = [np.array([0.5, 0.27, 0.27, 0.9])] + [
+        rng.uniform([0.05, 0.05, 0.05, 0.8], [0.95, 0.95, 0.95, 0.99])
+        for _ in range(restarts - 1)
+    ]
+    best = None
+    for s in starts:
+        res = optimize.minimize(sse_of, s, method="L-BFGS-B", bounds=bounds)
+        if best is None or res.fun < best.fun:
+            best = res
+    return float(best.fun), best.x.tolist()
+
+
+# ---------------------------------------------------------------------------
+# Fixture construction
+# ---------------------------------------------------------------------------
+
+def make_series(n: int = 157, m: int = 52, seed: int = 7) -> np.ndarray:
+    """Positive weekly demand-like series at reference scale (~157 weekly
+    points, ``01-data-generator.py:58,135-145``): trend + yearly
+    seasonality with level-proportional amplitude + noise."""
+    rng = np.random.default_rng(seed)
+    t = np.arange(n)
+    level = 50.0 + 0.12 * t
+    season = 1.0 + 0.25 * np.sin(2 * np.pi * t / m) + 0.08 * np.cos(4 * np.pi * t / m)
+    y = level * season + rng.normal(0, 2.5, n)
+    return np.maximum(y, 1.0)
+
+
+VARIANTS = {
+    "hw_add": dict(seasonal="add", damped=False),
+    "hw_add_damped": dict(seasonal="add", damped=True),
+    "hw_mul": dict(seasonal="mul", damped=False),
+    "hw_mul_damped": dict(seasonal="mul", damped=True),
+}
+
+# Pinned smoothing parameters (interior of the admissible region).
+PINNED = dict(alpha=0.35, beta=0.08, gamma=0.15, phi_damped=0.92)
+H_MAX = 12
+
+
+def main() -> None:
+    m = 52
+    y = make_series(m=m)
+
+    # scipy MLE lambda for the lambda-parity layer (Brent, unbounded —
+    # the JAX golden-section searches [-1, 2]; record whether the scipy
+    # optimum is interior to that bracket).
+    lam = float(stats.boxcox_normmax(y, method="mle"))
+
+    variants = {}
+    for name, kw in VARIANTS.items():
+        seasonal, damped = kw["seasonal"], kw["damped"]
+        phi = PINNED["phi_damped"] if damped else 1.0
+        fitted, sse, level, trend, season = oracle_smooth(
+            y, m, PINNED["alpha"], PINNED["beta"], PINNED["gamma"], phi, seasonal
+        )
+        fc = oracle_forecast(level, trend, season, phi, H_MAX, seasonal)
+        best_sse, best_x = oracle_fit(y, m, seasonal, damped)
+        variants[name] = {
+            "seasonal": seasonal,
+            "damped": damped,
+            "pinned": {
+                "alpha": PINNED["alpha"],
+                "beta": PINNED["beta"],
+                "gamma": PINNED["gamma"],
+                "phi": phi,
+            },
+            "fitted": fitted.tolist(),
+            "sse": sse,
+            "level": level,
+            "trend": trend,
+            "season": list(season),
+            "forecast": fc.tolist(),
+            "best_sse": best_sse,
+            "best_params": best_x,
+        }
+        print(f"{name}: pinned sse {sse:.2f}, oracle best sse {best_sse:.2f}")
+
+    out = {
+        "m": m,
+        "h_max": H_MAX,
+        "y": y.tolist(),
+        "boxcox_lambda": lam,
+        "boxcox_lambda_interior": bool(-1.0 < lam < 2.0),
+        "variants": variants,
+    }
+    path = Path(__file__).with_name("hw_golden.json")
+    path.write_text(json.dumps(out))
+    print(f"wrote {path} (lambda {lam:.4f})")
+
+
+if __name__ == "__main__":
+    main()

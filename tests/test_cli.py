@@ -52,6 +52,7 @@ def test_hpo_closure_mode(capsys):
     assert "closure" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_forecast_end_to_end(tmp_path, capsys, devices8):
     demand = tmp_path / "demand"
     main([
@@ -76,6 +77,7 @@ def test_forecast_end_to_end(tmp_path, capsys, devices8):
     assert "groups" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_train_cli_tiny(tmp_path, capsys, devices8):
     # Reuse the end-to-end fixture recipe: tiny JPEG Delta table.
     from test_end_to_end import _jpeg
@@ -100,6 +102,42 @@ def test_train_cli_tiny(tmp_path, capsys, devices8):
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert summary["steps"] == 4  # 64 rows // 16
     assert summary["images_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_train_cli_pretrained(tmp_path, capsys, devices8):
+    # Fine-tune from a synthetic torchvision-layout state dict
+    # (reference 2...py:150 fine-tunes IMAGENET1K_V2).
+    from test_end_to_end import _jpeg
+    from test_pretrained import tiny_torch_state
+    import pyarrow as pa
+
+    from dss_ml_at_scale_tpu.data import write_delta
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 32)
+    table = pa.table({
+        "content": pa.array([_jpeg(rng, l) for l in labels], type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    data = tmp_path / "images"
+    write_delta(table, data, max_rows_per_file=16)
+    weights = tmp_path / "weights.npz"
+    np.savez(weights, **tiny_torch_state(num_classes=4))
+
+    ckpt = tmp_path / "ckpt"
+    assert main([
+        "train", "--data", str(data), "--model", "tiny",
+        "--pretrained", str(weights), "--checkpoint-dir", str(ckpt),
+        "--num-classes", "4", "--crop", "64", "--batch-size", "16",
+        "--epochs", "1", "--learning-rate", "0.01",
+    ]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["steps"] == 2  # 32 rows // 16
+    assert summary["train_loss"] is not None
+    # The architecture choice is persisted for flag-less resumes.
+    meta = json.loads((ckpt / "dsst_model.json").read_text())
+    assert meta["torch_padding"] is True
 
 
 def test_topo_order_and_cycles():
@@ -200,6 +238,7 @@ def test_pipeline_summary_separates_failed_from_skipped(tmp_path, capsys):
     assert "pipeline failed: bad (skipped: down)" in out
 
 
+@pytest.mark.slow
 def test_eda_cli(tmp_path, capsys, devices8):
     demand = tmp_path / "demand"
     main([
@@ -230,6 +269,7 @@ def test_ingest_cli(tmp_path, capsys):
     assert "ingested 6 rows" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_pipeline_retries_until_success(tmp_path, capsys):
     # Task succeeds only once a marker file exists; first attempt creates
     # it via a failing-then-passing wrapper is overkill — instead verify

@@ -25,6 +25,7 @@ def test_extract_sku_series_defaults_to_first(demand_df):
         extract_sku_series(demand_df, sku="NOPE")
 
 
+@pytest.mark.slow
 def test_run_eda_report(devices8, demand_df):
     report = run_eda(
         demand_df,

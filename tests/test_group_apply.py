@@ -235,6 +235,7 @@ def test_split_train_score():
     assert score["x"].iloc[0] == 60
 
 
+@pytest.mark.slow
 def test_tune_and_forecast_panel(rng):
     df = add_exo_variables(_demand_frame(rng, n_sku=3, weeks=60))
     out = tune_and_forecast_panel(
@@ -249,6 +250,7 @@ def test_tune_and_forecast_panel(rng):
     assert mape.median() < 0.25
 
 
+@pytest.mark.slow
 def test_tune_and_forecast_panel_mesh_matches_unsharded(rng, devices8):
     # The flagship group-parallel claim (reference contract
     # group_apply/02...py:516-528, one task per group): G >> n_devices

@@ -1,0 +1,144 @@
+"""Golden-fixture parity tests for the Holt-Winters kernels.
+
+The fixture (``tests/fixtures/hw_golden.json``, regenerate with
+``python tests/fixtures/gen_hw_golden.py``) pins values from an
+independent plain-NumPy oracle (explicit loop recursions, scipy Box-Cox
+lambda, scipy bounded fits) for the four variants the reference's EDA
+compares (``group_apply/02_Fine_Grained_Demand_Forecasting.py:143-188``).
+
+Layers, strongest first:
+
+1. **Recursion math** — at pinned smoothing parameters the ``lax.scan``
+   recursion must reproduce the oracle's fitted values, SSE, and final
+   states (both implement the declared heuristic two-season init, so
+   this is tight f32-vs-f64 parity, not a modeling tolerance).
+2. **Forecast math** — ``holt_winters_forecast`` from the oracle's final
+   states must match the oracle's h-step forecasts (damped phi-sums,
+   seasonal buffer indexing, mul vs add application).
+3. **Box-Cox lambda** — golden-section MLE vs scipy Brent MLE.
+4. **Fit quality** — ``holt_winters_fit``'s achieved SSE vs the oracle's
+   multi-start scipy L-BFGS-B best (a stronger optimizer on the same
+   surface, so a fair bar with stated slack).
+
+The documented deviations from *statsmodels* (heuristic init, Box-Cox
+clamp — ``ops/holt_winters.py:10-22``) don't enter here: the oracle pins
+this implementation's declared semantics, independently re-implemented.
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dss_ml_at_scale_tpu.ops import holt_winters_fit, holt_winters_forecast
+from dss_ml_at_scale_tpu.ops.holt_winters import (
+    _SEASONAL_CODES,
+    HoltWintersResult,
+    _heuristic_init,
+    _smooth,
+    boxcox_mle_lambda,
+)
+
+FIXTURE = Path(__file__).parent / "fixtures" / "hw_golden.json"
+
+
+@pytest.fixture(scope="module")
+def golden():
+    fix = json.loads(FIXTURE.read_text())
+    fix["_y"] = jnp.asarray(fix["y"], jnp.float32)
+    return fix
+
+
+def _variant_ids(fix_path=FIXTURE):
+    return list(json.loads(fix_path.read_text())["variants"])
+
+
+VARIANT_NAMES = _variant_ids()
+
+
+@pytest.mark.parametrize("name", VARIANT_NAMES)
+def test_recursion_matches_oracle_at_pinned_params(golden, name):
+    var = golden["variants"][name]
+    pin = var["pinned"]
+    m = golden["m"]
+    y = golden["_y"]
+    init = _heuristic_init(y, m, var["seasonal"])
+    params = (
+        jnp.float32(pin["alpha"]), jnp.float32(pin["beta"]),
+        jnp.float32(pin["gamma"]), jnp.float32(pin["phi"]),
+    )
+    sse, fitted, level, trend, season = _smooth(
+        y, params, init, m, var["seasonal"], var["damped"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(fitted), np.asarray(var["fitted"]), rtol=2e-4, atol=2e-2
+    )
+    assert float(sse) == pytest.approx(var["sse"], rel=2e-4)
+    assert float(level) == pytest.approx(var["level"], rel=2e-4)
+    assert float(trend) == pytest.approx(var["trend"], rel=2e-3, abs=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(season), np.asarray(var["season"]), rtol=2e-3, atol=2e-3
+    )
+
+
+@pytest.mark.parametrize("name", VARIANT_NAMES)
+def test_forecast_matches_oracle_from_pinned_states(golden, name):
+    var = golden["variants"][name]
+    pin = var["pinned"]
+    result = HoltWintersResult(
+        alpha=jnp.float32(pin["alpha"]),
+        beta=jnp.float32(pin["beta"]),
+        gamma=jnp.float32(pin["gamma"]),
+        phi=jnp.float32(pin["phi"]),
+        boxcox_lambda=jnp.float32(1.0),
+        use_boxcox=jnp.asarray(False),
+        seasonal_code=jnp.asarray(_SEASONAL_CODES[var["seasonal"]], jnp.int32),
+        level=jnp.float32(var["level"]),
+        trend=jnp.float32(var["trend"]),
+        season=jnp.asarray(var["season"], jnp.float32),
+        fittedvalues=jnp.zeros(1),
+        sse=jnp.float32(0.0),
+    )
+    fc = holt_winters_forecast(result, golden["h_max"])
+    np.testing.assert_allclose(
+        np.asarray(fc), np.asarray(var["forecast"]), rtol=5e-4, atol=5e-2
+    )
+
+
+def test_boxcox_lambda_matches_scipy_mle(golden):
+    assert golden["boxcox_lambda_interior"], (
+        "fixture series' scipy MLE lambda left the [-1, 2] search bracket; "
+        "regenerate with a different series"
+    )
+    lam = float(boxcox_mle_lambda(golden["_y"]))
+    assert lam == pytest.approx(golden["boxcox_lambda"], abs=0.05)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", VARIANT_NAMES)
+def test_fit_quality_vs_oracle_best(golden, name):
+    var = golden["variants"][name]
+    res = holt_winters_fit(
+        golden["_y"], golden["m"], seasonal=var["seasonal"],
+        damped=var["damped"], use_boxcox=False, max_iter=600,
+    )
+    # Oracle best comes from multi-start bounded L-BFGS-B (f64); the f32
+    # Nelder-Mead must land within 5% SSE of it.
+    assert float(res.sse) <= var["best_sse"] * 1.05
+    assert np.isfinite(np.asarray(res.fittedvalues)).all()
+
+
+@pytest.mark.slow
+def test_boxcox_fit_estimates_fixture_lambda(golden):
+    res = holt_winters_fit(
+        golden["_y"], golden["m"], seasonal="add", damped=False,
+        use_boxcox=True, max_iter=400,
+    )
+    assert float(res.boxcox_lambda) == pytest.approx(
+        golden["boxcox_lambda"], abs=0.1
+    )
+    assert np.isfinite(np.asarray(res.fittedvalues)).all()
+    assert np.isfinite(float(res.sse))

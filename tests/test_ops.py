@@ -230,6 +230,7 @@ def test_sarimax_loglike_matches_closed_form(rng):
     assert abs(ll - _ar1_exact_loglike(y, 0.7, 1.0)) < 0.01
 
 
+@pytest.mark.slow
 def test_sarimax_ar1_fit_recovery(rng):
     y = _ar1_series(rng, 300)
     res = sarimax_fit(CFG0, jnp.array(y), jnp.zeros((300, 0)), jnp.array([1, 0, 0]))
@@ -246,6 +247,7 @@ def test_sarimax_ar1_fit_recovery(rng):
     assert float(res.loglike) >= ll_true - 0.5
 
 
+@pytest.mark.slow
 def test_sarimax_exog_and_difference(rng):
     # y = 5x + random walk: order (0,1,0) with one exog regressor.
     n = 300
@@ -258,6 +260,7 @@ def test_sarimax_exog_and_difference(rng):
     assert abs(float(beta[0]) - 5.0) < 0.3
 
 
+@pytest.mark.slow
 def test_sarimax_predict_full_range(rng):
     # Train region one-step predictions + dynamic forecast past n_valid,
     # mirroring predict(start=min(train), end=max(score), exog=score_exo).
@@ -279,6 +282,7 @@ def test_sarimax_predict_full_range(rng):
     assert fc_err.max() < 1.0
 
 
+@pytest.mark.slow
 def test_sarimax_vmap_different_orders_matches_single(rng):
     n = 200
     y1 = _ar1_series(rng, n)

@@ -1,0 +1,286 @@
+"""Torchvision-layout pretrained weight loading (models/pretrained.py).
+
+The reference fine-tunes torchvision's pretrained
+``resnet50(weights="IMAGENET1K_V2")`` (reference
+``deep_learning/2.distributed-data-loading-petastorm.py:150``). These
+tests build *synthetic* torchvision-layout state dicts (hand-listed
+keys, no torch needed) for small ResNet geometries and verify the
+Flax-tree conversion: full coverage, transpose correctness, error
+behavior, and the torch_padding numeric contract.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dss_ml_at_scale_tpu.models.pretrained import (
+    convert_torchvision_resnet,
+    load_pretrained_resnet,
+    load_state_dict,
+)
+from dss_ml_at_scale_tpu.models.resnet import BottleneckBlock, ResNet, ResNetBlock
+
+
+def _bn(state, prefix, c, rng):
+    state[f"{prefix}.weight"] = rng.normal(size=c).astype(np.float32)
+    state[f"{prefix}.bias"] = rng.normal(size=c).astype(np.float32)
+    state[f"{prefix}.running_mean"] = rng.normal(size=c).astype(np.float32)
+    state[f"{prefix}.running_var"] = rng.uniform(0.5, 2.0, size=c).astype(np.float32)
+    # Torchvision state dicts carry this; the converter must ignore it.
+    state[f"{prefix}.num_batches_tracked"] = np.asarray(0, np.int64)
+
+
+def tiny_torch_state(num_classes=4, seed=0):
+    """Hand-written torchvision layout for ResNet(stage_sizes=[1, 1],
+    ResNetBlock, num_filters=8) — resnet18-style basic blocks.
+
+    Keys are listed independently of the converter's mapping so the test
+    is not circular.
+    """
+    rng = np.random.default_rng(seed)
+    s = {}
+    s["conv1.weight"] = rng.normal(size=(8, 3, 7, 7)).astype(np.float32)
+    _bn(s, "bn1", 8, rng)
+    # layer1.0: basic block, 8 -> 8, stride 1, no downsample.
+    s["layer1.0.conv1.weight"] = rng.normal(size=(8, 8, 3, 3)).astype(np.float32)
+    _bn(s, "layer1.0.bn1", 8, rng)
+    s["layer1.0.conv2.weight"] = rng.normal(size=(8, 8, 3, 3)).astype(np.float32)
+    _bn(s, "layer1.0.bn2", 8, rng)
+    # layer2.0: 8 -> 16, stride 2, with downsample projection.
+    s["layer2.0.conv1.weight"] = rng.normal(size=(16, 8, 3, 3)).astype(np.float32)
+    _bn(s, "layer2.0.bn1", 16, rng)
+    s["layer2.0.conv2.weight"] = rng.normal(size=(16, 16, 3, 3)).astype(np.float32)
+    _bn(s, "layer2.0.bn2", 16, rng)
+    s["layer2.0.downsample.0.weight"] = rng.normal(size=(16, 8, 1, 1)).astype(
+        np.float32
+    )
+    _bn(s, "layer2.0.downsample.1", 16, rng)
+    s["fc.weight"] = rng.normal(size=(num_classes, 16)).astype(np.float32)
+    s["fc.bias"] = rng.normal(size=num_classes).astype(np.float32)
+    return s
+
+
+def _tiny_model(**kw):
+    return ResNet(
+        stage_sizes=[1, 1], block_cls=ResNetBlock, num_filters=8,
+        num_classes=4, dtype=jnp.float32, **kw,
+    )
+
+
+def _template(model, size=32):
+    return model.init(jax.random.key(0), jnp.zeros((1, size, size, 3)), train=False)
+
+
+class TestConvertBasicBlocks:
+    def test_full_tree_round_trip(self):
+        state = tiny_torch_state()
+        model = _tiny_model(torch_padding=True)
+        template = _template(model)
+        out = convert_torchvision_resnet(state, template, model.stage_sizes)
+
+        p, bs = out["params"], out["batch_stats"]
+        # Stem: OIHW -> HWIO.
+        np.testing.assert_array_equal(
+            p["conv_init"]["kernel"], np.transpose(state["conv1.weight"], (2, 3, 1, 0))
+        )
+        np.testing.assert_array_equal(p["norm_init"]["scale"], state["bn1.weight"])
+        np.testing.assert_array_equal(
+            bs["norm_init"]["mean"], state["bn1.running_mean"]
+        )
+        np.testing.assert_array_equal(
+            bs["norm_init"]["var"], state["bn1.running_var"]
+        )
+        # Blocks: flax numbers globally, torch per stage — block 1 is layer2.0.
+        np.testing.assert_array_equal(
+            p["ResNetBlock_0"]["Conv_0"]["kernel"],
+            np.transpose(state["layer1.0.conv1.weight"], (2, 3, 1, 0)),
+        )
+        np.testing.assert_array_equal(
+            p["ResNetBlock_1"]["Conv_1"]["kernel"],
+            np.transpose(state["layer2.0.conv2.weight"], (2, 3, 1, 0)),
+        )
+        np.testing.assert_array_equal(
+            p["ResNetBlock_1"]["conv_proj"]["kernel"],
+            np.transpose(state["layer2.0.downsample.0.weight"], (2, 3, 1, 0)),
+        )
+        np.testing.assert_array_equal(
+            p["ResNetBlock_1"]["norm_proj"]["bias"],
+            state["layer2.0.downsample.1.bias"],
+        )
+        np.testing.assert_array_equal(
+            bs["ResNetBlock_1"]["BatchNorm_0"]["var"],
+            state["layer2.0.bn1.running_var"],
+        )
+        # Head: [out, in] -> [in, out].
+        np.testing.assert_array_equal(
+            p["Dense_0"]["kernel"], state["fc.weight"].T
+        )
+        np.testing.assert_array_equal(p["Dense_0"]["bias"], state["fc.bias"])
+        # Coverage: converted tree has the template's paths and shapes exactly.
+        flat_out, _ = jax.tree_util.tree_flatten_with_path(out)
+        flat_tpl, _ = jax.tree_util.tree_flatten_with_path(
+            jax.tree_util.tree_map(np.asarray, dict(template))
+        )
+        assert [p for p, _ in flat_out] == [p for p, _ in flat_tpl]
+        assert all(
+            a.shape == b.shape for (_, a), (_, b) in zip(flat_out, flat_tpl)
+        )
+
+    def test_missing_key_raises(self):
+        state = tiny_torch_state()
+        del state["fc.bias"]
+        model = _tiny_model()
+        with pytest.raises(KeyError, match="fc.bias"):
+            convert_torchvision_resnet(state, _template(model), model.stage_sizes)
+
+    def test_shape_mismatch_raises(self):
+        state = tiny_torch_state()
+        state["conv1.weight"] = state["conv1.weight"][:, :, :3, :3]
+        model = _tiny_model()
+        with pytest.raises(ValueError, match="conv1.weight"):
+            convert_torchvision_resnet(state, _template(model), model.stage_sizes)
+
+    def test_converted_model_runs(self):
+        state = tiny_torch_state()
+        model = _tiny_model(torch_padding=True)
+        out = convert_torchvision_resnet(state, _template(model), model.stage_sizes)
+        logits = model.apply(out, jnp.ones((2, 32, 32, 3)), train=False)
+        assert logits.shape == (2, 4)
+        assert np.isfinite(np.asarray(logits)).all()
+
+
+def bottleneck_torch_state(seed=0):
+    """Hand-written layout for ResNet(stage_sizes=[1], BottleneckBlock,
+    num_filters=8) — resnet50-style 3-conv blocks, 4x expansion."""
+    rng = np.random.default_rng(seed)
+    s = {}
+    s["conv1.weight"] = rng.normal(size=(8, 3, 7, 7)).astype(np.float32)
+    _bn(s, "bn1", 8, rng)
+    # layer1.0: 1x1(8) -> 3x3(8) -> 1x1(32), downsample 8 -> 32.
+    s["layer1.0.conv1.weight"] = rng.normal(size=(8, 8, 1, 1)).astype(np.float32)
+    _bn(s, "layer1.0.bn1", 8, rng)
+    s["layer1.0.conv2.weight"] = rng.normal(size=(8, 8, 3, 3)).astype(np.float32)
+    _bn(s, "layer1.0.bn2", 8, rng)
+    s["layer1.0.conv3.weight"] = rng.normal(size=(32, 8, 1, 1)).astype(np.float32)
+    _bn(s, "layer1.0.bn3", 32, rng)
+    s["layer1.0.downsample.0.weight"] = rng.normal(size=(32, 8, 1, 1)).astype(
+        np.float32
+    )
+    _bn(s, "layer1.0.downsample.1", 32, rng)
+    s["fc.weight"] = rng.normal(size=(4, 32)).astype(np.float32)
+    s["fc.bias"] = rng.normal(size=4).astype(np.float32)
+    return s
+
+
+def test_convert_bottleneck_blocks():
+    state = bottleneck_torch_state()
+    model = ResNet(
+        stage_sizes=[1], block_cls=BottleneckBlock, num_filters=8,
+        num_classes=4, dtype=jnp.float32,
+    )
+    template = _template(model)
+    out = convert_torchvision_resnet(state, template, model.stage_sizes)
+    p = out["params"]
+    np.testing.assert_array_equal(
+        p["BottleneckBlock_0"]["Conv_2"]["kernel"],
+        np.transpose(state["layer1.0.conv3.weight"], (2, 3, 1, 0)),
+    )
+    np.testing.assert_array_equal(
+        out["batch_stats"]["BottleneckBlock_0"]["BatchNorm_2"]["mean"],
+        state["layer1.0.bn3.running_mean"],
+    )
+
+
+class TestTorchPadding:
+    """torchvision pads stride-2 convs (k-1)//2 each side; XLA SAME pads
+    asymmetrically on even inputs (models/resnet.py:92-96)."""
+
+    def test_stride2_conv_padding_differs_on_even_input(self):
+        import flax.linen as nn
+
+        x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+        kernel = jnp.ones((3, 3, 1, 1))
+
+        def run(padding):
+            conv = nn.Conv(1, (3, 3), (2, 2), padding=padding, use_bias=False)
+            return conv.apply({"params": {"kernel": kernel}}, x)
+
+        y_torch = np.asarray(run(((1, 1), (1, 1))))[0, :, :, 0]
+        y_same = np.asarray(run("SAME"))[0, :, :, 0]
+        # Torch padding: window at (0,0) covers input rows/cols 0..1.
+        xn = np.arange(16, dtype=np.float32).reshape(4, 4)
+        assert y_torch[0, 0] == xn[0:2, 0:2].sum()
+        # XLA SAME on even input pads only hi: window covers rows/cols 0..2.
+        assert y_same[0, 0] == xn[0:3, 0:3].sum()
+        assert not np.allclose(y_torch, y_same)
+
+    def test_model_outputs_differ_with_same_params(self):
+        model_tp = _tiny_model(torch_padding=True)
+        model_same = _tiny_model(torch_padding=False)
+        variables = _template(model_same)  # identical param shapes
+        x = jax.random.normal(jax.random.key(1), (1, 32, 32, 3))
+        y_tp = model_tp.apply(variables, x, train=False)
+        y_same = model_same.apply(variables, x, train=False)
+        assert not np.allclose(np.asarray(y_tp), np.asarray(y_same))
+
+
+def test_reinit_head_loads_backbone_keeps_fresh_head(tmp_path):
+    # Fine-tune-to-new-labels: checkpoint has 4 classes, model wants 7.
+    state = tiny_torch_state(num_classes=4)
+    path = tmp_path / "w.npz"
+    np.savez(path, **state)
+    model = ResNet(
+        stage_sizes=[1, 1], block_cls=ResNetBlock, num_filters=8,
+        num_classes=7, dtype=jnp.float32, torch_padding=True,
+    )
+    template = _template(model)
+    out = load_pretrained_resnet(path, model, image_size=32)
+    # Backbone loaded from the checkpoint...
+    np.testing.assert_array_equal(
+        out["params"]["conv_init"]["kernel"],
+        np.transpose(state["conv1.weight"], (2, 3, 1, 0)),
+    )
+    # ...head kept at its fresh (template) initialization, right shape.
+    assert out["params"]["Dense_0"]["kernel"].shape == (16, 7)
+    np.testing.assert_array_equal(
+        out["params"]["Dense_0"]["kernel"],
+        np.asarray(template["params"]["Dense_0"]["kernel"]),
+    )
+
+
+def test_backbone_only_export_gets_fresh_head(tmp_path):
+    # Transfer-learning exports often drop fc.* entirely.
+    state = tiny_torch_state(num_classes=4)
+    del state["fc.weight"], state["fc.bias"]
+    path = tmp_path / "backbone.npz"
+    np.savez(path, **state)
+    model = _tiny_model(torch_padding=True)
+    template = _template(model)
+    out = load_pretrained_resnet(path, model, image_size=32)
+    np.testing.assert_array_equal(
+        out["params"]["conv_init"]["kernel"],
+        np.transpose(state["conv1.weight"], (2, 3, 1, 0)),
+    )
+    np.testing.assert_array_equal(
+        out["params"]["Dense_0"]["kernel"],
+        np.asarray(template["params"]["Dense_0"]["kernel"]),
+    )
+
+
+def test_load_pretrained_resnet_npz_round_trip(tmp_path):
+    state = tiny_torch_state()
+    path = tmp_path / "weights.npz"
+    np.savez(path, **state)
+    loaded = load_state_dict(path)
+    assert set(loaded) == set(state)
+    model = _tiny_model(torch_padding=True)
+    out = load_pretrained_resnet(path, model, image_size=32)
+    np.testing.assert_array_equal(
+        out["params"]["conv_init"]["kernel"],
+        np.transpose(state["conv1.weight"], (2, 3, 1, 0)),
+    )
+    np.testing.assert_array_equal(
+        out["batch_stats"]["norm_init"]["mean"], state["bn1.running_mean"]
+    )

@@ -109,6 +109,7 @@ FIT_TOL = {
 }
 
 
+@pytest.mark.slow
 def test_fit_quality_at_grid_corners(golden):
     cfg = SarimaxConfig(k_exog=3, max_iter=600)
     for bar in golden["fits"]:

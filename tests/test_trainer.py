@@ -42,6 +42,7 @@ def test_loss_decreases_on_learnable_task(devices8, task):
     assert result.history[1]["train_acc"] > 0.5
 
 
+@pytest.mark.slow
 def test_eval_and_best_tracking(devices8, task, tmp_path):
     mesh = make_mesh()
     trainer = Trainer(
@@ -65,6 +66,7 @@ def test_eval_and_best_tracking(devices8, task, tmp_path):
     assert (tmp_path / "ckpt").exists()
 
 
+@pytest.mark.slow
 def test_resume_from_checkpoint(devices8, task, tmp_path):
     mesh = make_mesh()
     cfg = dict(
