@@ -286,10 +286,13 @@ def register_train(sub: argparse._SubParsersAction) -> None:
         "class count differs from --num-classes is freshly initialized",
     )
     tr.add_argument(
-        "--torch-padding", action="store_true", default=None,
-        help="force torchvision-style symmetric stride-2 padding; needed "
-        "when resuming a --pretrained run without re-passing --pretrained "
-        "(the checkpoint's BatchNorm statistics embed the padding choice)",
+        "--torch-padding", action=argparse.BooleanOptionalAction, default=None,
+        help="force torchvision-style symmetric stride-2 padding (or "
+        "--no-torch-padding to force it off); needed when resuming a "
+        "--pretrained run without re-passing --pretrained (the "
+        "checkpoint's BatchNorm statistics embed the padding choice); "
+        "default: True with --pretrained, else the value persisted in "
+        "the checkpoint dir, else False",
     )
     tr.add_argument("--workers", type=int, default=2)
     tr.add_argument("--queue-size", type=int, default=20)

@@ -39,6 +39,10 @@ class TransformSpec:
 
     func: Callable[[Columnar], Columnar]
     fields: Sequence[Field]
+    # Provenance for harness reporting (what decode path / image layout a
+    # factory actually resolved to); None for hand-built specs.
+    backend: str | None = None
+    layout: str | None = None
 
     def __call__(self, batch: Columnar) -> dict[str, np.ndarray]:
         out = dict(self.func(batch))
@@ -71,14 +75,20 @@ IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 
-def decode_resize_crop(jpeg_bytes: bytes, resize: int = 256, crop: int = 224) -> np.ndarray:
-    """JPEG → float32 CHW in [0,1], shorter-side resize then center crop.
+def decode_resize_crop(
+    jpeg_bytes: bytes, resize: int = 256, crop: int = 224, layout: str = "chw"
+) -> np.ndarray:
+    """JPEG → float32 in [0,1], shorter-side resize then center crop.
 
     Matches torchvision's Resize(256)/CenterCrop(224)/ToTensor semantics
     used by the reference's ``preprocess`` (``deep_learning/2...py:282-296``).
+    ``layout="chw"`` is the torchvision tensor layout; ``"hwc"`` skips the
+    transpose (TPU convs are NHWC-native).
     """
     from PIL import Image
 
+    if layout not in ("hwc", "chw"):
+        raise ValueError(f"unknown layout {layout!r}")
     img = Image.open(io.BytesIO(jpeg_bytes)).convert("RGB")
     w, h = img.size
     scale = resize / min(w, h)
@@ -87,7 +97,7 @@ def decode_resize_crop(jpeg_bytes: bytes, resize: int = 256, crop: int = 224) ->
     left, top = (w - crop) // 2, (h - crop) // 2
     img = img.crop((left, top, left + crop, top + crop))
     arr = np.asarray(img, np.float32) / 255.0  # HWC
-    return arr.transpose(2, 0, 1)  # CHW
+    return arr if layout == "hwc" else arr.transpose(2, 0, 1)
 
 
 def imagenet_transform_spec(
@@ -99,20 +109,30 @@ def imagenet_transform_spec(
     normalize: bool = True,
     backend: str = "auto",
     decode_threads: int | None = None,
+    layout: str = "hwc",
 ) -> TransformSpec:
     """The reference's training TransformSpec, columnar.
 
-    Emits ``image`` float32 (3,crop,crop) and ``label`` int32 — the same
-    field contract as ``deep_learning/2...py:310-318``.
+    Emits ``image`` float32 and ``label`` int32 — the field contract of
+    ``deep_learning/2...py:310-318``, except that the default image
+    layout is HWC, not torchvision's CHW: TPU convolutions are
+    NHWC-native, and emitting NHWC from the decode pool means the jitted
+    train step never spends HBM bandwidth transposing every batch
+    (``ClassifierTask._images`` accepts either and transposes only CHW).
+    Pass ``layout="chw"`` for bit-parity tests against torch pipelines.
 
     ``backend``: ``"native"`` uses the C++ decode pool
     (:mod:`dss_ml_at_scale_tpu.native` — GIL-free libjpeg + threaded
     resize/crop/normalize), ``"pil"`` the pure-Python path, ``"auto"``
     native when it compiles on this host with per-image PIL fallback for
-    codecs the native path rejects (e.g. CMYK JPEGs).
+    codecs the native path rejects (e.g. CMYK JPEGs). The resolved
+    backend is exposed as ``spec.backend`` so harnesses can report what
+    actually ran.
     """
     if backend not in ("auto", "native", "pil"):
         raise ValueError(f"unknown backend {backend!r}")
+    if layout not in ("hwc", "chw"):
+        raise ValueError(f"unknown layout {layout!r}")
     if crop > resize:
         # crop > resize would mean padding/stretching, and the native and
         # PIL paths disagree on which; the reference never does it (256/224).
@@ -132,9 +152,12 @@ def imagenet_transform_spec(
     )
 
     def _decode_pil(b: bytes) -> np.ndarray:
-        img = decode_resize_crop(b, resize=resize, crop=crop)
+        img = decode_resize_crop(b, resize=resize, crop=crop, layout=layout)
         if normalize:
-            img = (img - IMAGENET_MEAN[:, None, None]) / IMAGENET_STD[:, None, None]
+            stats_shape = (1, 1, 3) if layout == "hwc" else (3, 1, 1)
+            img = (img - IMAGENET_MEAN.reshape(stats_shape)) / IMAGENET_STD.reshape(
+                stats_shape
+            )
         return img
 
     def _func(batch: Columnar) -> Columnar:
@@ -146,7 +169,7 @@ def imagenet_transform_spec(
                 crop=crop,
                 mean=IMAGENET_MEAN if normalize else None,
                 std=IMAGENET_STD if normalize else None,
-                chw=True,
+                chw=layout == "chw",
                 num_threads=decode_threads,
             )
             if not ok.all():
@@ -160,10 +183,13 @@ def imagenet_transform_spec(
         labels = np.asarray(batch[label_column], np.int32)
         return {"image": images, "label": labels}
 
+    image_shape = (crop, crop, 3) if layout == "hwc" else (3, crop, crop)
     return TransformSpec(
         func=_func,
         fields=[
-            Field("image", np.dtype(np.float32), (3, crop, crop)),
+            Field("image", np.dtype(np.float32), image_shape),
             Field("label", np.dtype(np.int32), ()),
         ],
+        backend="native" if use_native else "pil",
+        layout=layout,
     )

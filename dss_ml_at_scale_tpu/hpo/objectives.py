@@ -9,6 +9,8 @@ executors (``hyperopt/1. hyperopt.py:54-62``).
 
 from __future__ import annotations
 
+from .shipping import Broadcast
+
 
 def quadratic(args) -> float:
     """Smooth 1-D bowl with minimum at x = 3."""
@@ -50,6 +52,47 @@ def brittle_group_head(group):
     if group["SKU"].iloc[0] == "SKU2":
         raise RuntimeError("group blew up")
     return group.head(1)[["SKU"]]
+
+
+# -- broadcast regime (~100 MB: hyperopt/2...py:90-101) ----------------------
+#
+# The module-level handle is the cross-host shipping mechanism: workers
+# import this module, so referencing the objective by name gives every
+# worker process its own lazy Broadcast that materializes exactly once
+# there, no matter how many trials land on it (sc.broadcast semantics
+# without a JVM). The build counter lets tests prove the once-per-process
+# claim from outside.
+
+_BROADCAST_BUILDS = 0
+
+
+def _regression_broadcast_factory():
+    global _BROADCAST_BUILDS
+    _BROADCAST_BUILDS += 1
+    from ..datagen.regression import gen_data
+
+    # Sized-down stand-in for the ~100 MB regime; deterministic so every
+    # worker materializes the same dataset.
+    return gen_data(1_000_000)
+
+
+REGRESSION_BROADCAST = Broadcast(factory=_regression_broadcast_factory)
+
+
+def lasso_broadcast(args) -> dict:
+    """Lasso fit against a per-process-broadcast dataset.
+
+    Result carries the worker pid and the process's factory-build count
+    so a sweep can verify one materialization per worker process.
+    """
+    import os
+
+    from ..datagen.regression import train_and_eval
+
+    result = train_and_eval(REGRESSION_BROADCAST.value, args["alpha"])
+    result["pid"] = os.getpid()
+    result["broadcast_builds"] = _BROADCAST_BUILDS
+    return result
 
 
 def lasso_shared(args) -> dict:

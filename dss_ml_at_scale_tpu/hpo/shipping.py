@@ -10,7 +10,14 @@ reaches distributed trial workers at three size regimes —
 2. **~100 MB: broadcast** (``sc.broadcast`` / ``.value``, ``:90-101``).
    Spark needs an explicit broadcast to avoid re-pickling per task; here
    :class:`Broadcast` is a once-per-host handle that multi-host trial
-   executors materialize exactly once per process.
+   executors materialize exactly once per process. Cross-host usage:
+   define a *module-level* ``Broadcast(factory=...)`` next to a
+   module-level objective (see
+   ``hpo/objectives.py:REGRESSION_BROADCAST``/``lasso_broadcast``) and
+   pass the objective by reference to :class:`~dss_ml_at_scale_tpu.
+   parallel.trials.HostTrials` — each worker process imports the module
+   and builds the value on its first trial; every later trial on that
+   worker shares it. The factory, not the data, is what ships.
 3. **≥ ~1 GB: shared filesystem** (npz save/load helpers, ``:114-152``).
    :func:`save_shared` / :func:`load_shared` reproduce the
    ``save_to_dbfs``/``load`` pattern against any mounted path (NFS/GCS

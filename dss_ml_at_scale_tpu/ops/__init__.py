@@ -16,6 +16,7 @@ from .flash_attention import attention_reference, flash_attention
 from .holt_winters import HoltWintersResult, holt_winters_fit, holt_winters_forecast
 from .kalman import kalman_filter, kalman_forecast
 from .neldermead import NelderMeadResult, nelder_mead
+from .polish import sarimax_polish
 from .sarimax import (
     SarimaxConfig,
     SarimaxResult,
@@ -40,5 +41,6 @@ __all__ = [
     "SarimaxResult",
     "sarimax_fit",
     "sarimax_loglike",
+    "sarimax_polish",
     "sarimax_predict",
 ]

@@ -64,8 +64,9 @@ class ClassifierTask:
     cross-entropy, top-1 accuracy on eval.
 
     Expects batches with ``image`` (NHWC or NCHW float32) and ``label``
-    (int). NCHW input is transposed once on device — the decode pipeline
-    produces CHW rows for torchvision parity, TPU convs want NHWC.
+    (int). The decode pipeline emits NHWC by default (TPU convs are
+    NHWC-native, so the hot path never transposes on device); CHW input
+    (``layout="chw"`` torchvision-parity specs) is transposed once here.
     """
 
     model: Any

@@ -128,12 +128,18 @@ def run_eda(
 
     # -- SARIMAX with / without exog -------------------------------------
     cfg = cfg or SarimaxConfig(k_exog=len(EXO_FIELDS))
+    # The no-exog variant gets a k_exog=0 config — passing a zero exog
+    # matrix under k_exog=3 would leave beta with a flat likelihood
+    # direction the optimizer has to drag along (11 padded dims is
+    # enough already).
+    cfg_no_exog = dataclasses.replace(cfg, k_exog=0)
     order = np.asarray(sarimax_order, np.int32)
 
     def sarimax_mse(use_exog: bool) -> float:
-        ex = exog if use_exog else np.zeros_like(exog)
-        fit = sarimax_fit(cfg, y, ex, order, n_train)
-        pred = np.asarray(sarimax_predict(cfg, fit.params, y, ex, order, n_train))
+        c = cfg if use_exog else cfg_no_exog
+        ex = exog if use_exog else np.zeros((len(y), 0), np.float32)
+        fit = sarimax_fit(c, y, ex, order, n_train)
+        pred = np.asarray(sarimax_predict(c, fit.params, y, ex, order, n_train))
         return _holdout_mse(pred[n_train:], y_score)
 
     rows.append({"model": "sarimax_exog", "mse": sarimax_mse(True)})

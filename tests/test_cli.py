@@ -291,6 +291,7 @@ def test_pipeline_retries_until_success(tmp_path, capsys):
     assert "attempt 1/3" in out and "attempt 3/3" in out
 
 
+@pytest.mark.slow
 def test_hpo_remote_workers_cli(tmp_path, capsys):
     npz = tmp_path / "reg.npz"
     main(["datagen", "regression", "--bytes", "200000", "--out", str(npz)])

@@ -73,7 +73,7 @@ def test_transform_spec_native_backend_matches_pil(rng):
     }
     out_native = imagenet_transform_spec(backend="native")(batch)
     out_pil = imagenet_transform_spec(backend="pil")(batch)
-    assert out_native["image"].shape == (3, 3, 224, 224)
+    assert out_native["image"].shape == (3, 224, 224, 3)
     assert np.mean(np.abs(out_native["image"] - out_pil["image"])) < 0.05
     np.testing.assert_array_equal(out_native["label"], out_pil["label"])
 

@@ -105,6 +105,7 @@ def test_transformer_flash_matches_reference_attention(rng):
     )
 
 
+@pytest.mark.slow
 def test_transformer_ring_sequence_parallel_train_step(rng, seq_mesh):
     # The long-context training shape: batch=1, sequence sharded 8-way,
     # one full train step (fwd+bwd+Adam) jitted over the mesh.

@@ -237,6 +237,49 @@ def test_trial_worker_cli_subprocess(tmp_path):
         proc.wait(timeout=10)
 
 
+def test_broadcast_materializes_once_per_worker_process(tmp_path):
+    """The ~100 MB shipping regime across a real process boundary
+    (``hyperopt/2...py:90-101``): two worker processes, six trials — each
+    process builds the module-level ``Broadcast(factory)`` exactly once
+    and every trial on that process shares it."""
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "dss_ml_at_scale_tpu.config.cli",
+             "trial-worker", "--bind", "127.0.0.1:0"],
+            stdout=subprocess.PIPE, text=True,
+        )
+        for _ in range(2)
+    ]
+    try:
+        addrs = [p.stdout.readline().strip().rsplit(" ", 1)[-1] for p in procs]
+        trials = HostTrials(addrs, parallelism=2)
+        fmin(
+            "dss_ml_at_scale_tpu.hpo.objectives:lasso_broadcast",
+            {"alpha": hp.uniform("alpha", 0.01, 2.0)},
+            max_evals=6,
+            trials=trials,
+            rstate=np.random.default_rng(0),
+        )
+        results = [t["result"] for t in trials.trials]
+        assert all(r["status"] == STATUS_OK for r in results)
+        by_pid: dict[int, list[dict]] = {}
+        for r in results:
+            by_pid.setdefault(r["pid"], []).append(r)
+        # Trials actually spread across both worker processes...
+        assert len(by_pid) == 2, f"expected 2 worker pids, got {by_pid.keys()}"
+        # ...and no process ever ran the factory more than once.
+        for pid, rs in by_pid.items():
+            assert all(r["broadcast_builds"] == 1 for r in rs), (
+                f"worker {pid} rebuilt the broadcast: "
+                f"{[r['broadcast_builds'] for r in rs]}"
+            )
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+
+
 def test_fmin_rejects_string_objective_on_local_executors():
     from dss_ml_at_scale_tpu.hpo import Trials
 

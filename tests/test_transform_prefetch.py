@@ -30,16 +30,29 @@ def test_decode_resize_crop_shapes():
 
 def test_imagenet_spec_normalizes():
     spec = imagenet_transform_spec()
+    assert spec.layout == "hwc"  # TPU-native default: no device transpose
     batch = {
         "content": np.array([_jpeg(300, 260), _jpeg(260, 300, (0, 0, 255))], dtype=object),
         "label_index": np.array([3, 7]),
     }
     out = spec(batch)
-    assert out["image"].shape == (2, 3, 224, 224)
+    assert out["image"].shape == (2, 224, 224, 3)
     assert out["label"].tolist() == [3, 7]
     # red channel of a pure-red jpeg ≈ (1 - mean)/std after normalize
-    red = out["image"][0, 0].mean()
+    red = out["image"][0, :, :, 0].mean()
     assert abs(red - (1.0 - IMAGENET_MEAN[0]) / IMAGENET_STD[0]) < 0.05
+
+
+def test_imagenet_spec_chw_layout_matches_hwc():
+    # torchvision-parity layout: same pixels, transposed.
+    batch = {
+        "content": np.array([_jpeg(300, 260)], dtype=object),
+        "label_index": np.array([0]),
+    }
+    hwc = imagenet_transform_spec(layout="hwc")(batch)["image"]
+    chw = imagenet_transform_spec(layout="chw")(batch)["image"]
+    assert chw.shape == (1, 3, 224, 224)
+    np.testing.assert_array_equal(chw, hwc.transpose(0, 3, 1, 2))
 
 
 def test_prefetch_to_mesh_shards_batches(devices8):
