@@ -88,6 +88,7 @@ def test_group_apply_failure_isolation(rng):
     assert set(out["SKU"]) == {"SKU0", "SKU1", "SKU3"}
 
 
+@pytest.mark.slow
 def test_group_apply_process_executor(rng):
     # GIL-bound per-group fns get real process isolation (the reference's
     # execution shape: one Python worker process per Spark task). The fn
@@ -108,6 +109,7 @@ def test_group_apply_process_executor(rng):
     assert (out["pid"] != os.getpid()).all(), "groups ran in-process"
 
 
+@pytest.mark.slow
 def test_group_apply_process_executor_failure_isolation(rng):
     from dss_ml_at_scale_tpu.hpo.objectives import brittle_group_head
 

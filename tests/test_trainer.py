@@ -133,6 +133,7 @@ def test_checkpoint_retention_without_val(devices8, task, tmp_path):
     assert len(kept) == 2
 
 
+@pytest.mark.slow
 def test_lm_task_trains_under_trainer(devices8):
     import jax.numpy as jnp
     import optax
