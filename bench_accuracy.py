@@ -83,6 +83,11 @@ def main() -> int:
     ap.add_argument("--epochs", type=int, default=8)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--target", type=float, default=0.90)
+    ap.add_argument(
+        "--cpu", action="store_true",
+        help="force the CPU backend (accuracy is hardware-independent; "
+        "use when the accelerator is unavailable)",
+    )
     args = ap.parse_args()
 
     import tempfile
@@ -90,6 +95,9 @@ def main() -> int:
     import optax
 
     import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     from dss_ml_at_scale_tpu.data import DeltaTable, batch_loader
     from dss_ml_at_scale_tpu.data.transform import imagenet_transform_spec

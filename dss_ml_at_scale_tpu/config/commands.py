@@ -296,6 +296,17 @@ def register_train(sub: argparse._SubParsersAction) -> None:
     )
     tr.add_argument("--workers", type=int, default=2)
     tr.add_argument("--queue-size", type=int, default=20)
+    tr.add_argument(
+        "--shard-opt-state", action="store_true",
+        help="ZeRO-1: shard optimizer state over the data axis instead of "
+        "replicating it (same math, ~world-size less optimizer memory)",
+    )
+    tr.add_argument(
+        "--decode-backend", choices=["auto", "native", "pil"], default="auto",
+        help="JPEG decode path: the C++ pool, pure-PIL, or auto (native "
+        "when it compiles, per-image PIL fallback); the resolved backend "
+        "is reported in the run summary",
+    )
     tr.add_argument("--limit-val-batches", type=int, default=5)
     tr.add_argument("--checkpoint-dir", default=None)
     tr.add_argument("--resume", action="store_true")
@@ -326,7 +337,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
 
     table = DeltaTable(args.data)
     rows = table.num_records()
-    spec = imagenet_transform_spec(crop=args.crop)
+    spec = imagenet_transform_spec(crop=args.crop, backend=args.decode_backend)
     # Pretrained torchvision weights embed symmetric stride-2 padding in
     # their BatchNorm statistics; the model must match (models/pretrained.py).
     # The choice is persisted next to the checkpoint so a later --resume
@@ -397,6 +408,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
             checkpoint_dir=args.checkpoint_dir,
             resume=args.resume,
             profile_dir=args.profile_dir,
+            shard_opt_state=args.shard_opt_state,
         ),
         mesh=make_mesh(),
         tracker=tracker,
@@ -439,6 +451,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 "train_loss": last.get("train_loss"),
                 "val_acc": last.get("val_acc"),
                 "best_checkpoint": result.best_checkpoint_path,
+                "decode_backend": spec.backend,
             }
         )
     )
