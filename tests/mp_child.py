@@ -39,6 +39,11 @@ def main() -> None:
     ap.add_argument("--num-processes", type=int, default=2)
     ap.add_argument("--data", required=True)
     ap.add_argument("--workdir", required=True)
+    ap.add_argument(
+        "--train-data", default=None,
+        help="JPEG Delta table; when set, both processes also run a "
+        "multi-host `dsst train` epoch over it",
+    )
     args = ap.parse_args()
     workdir = Path(args.workdir)
 
@@ -130,6 +135,35 @@ def main() -> None:
             1 for t in trials.trials if t["result"]["status"] == "ok"
         )
         done_file.write_text("done")
+
+    # -- real multi-host DP training through the train CLI ----------------
+    # Both processes run the same `dsst train` command; the trainer
+    # builds a global 2-device mesh, each process decodes its own reader
+    # shard, and `shard_batch_to_mesh` assembles per-process rows into
+    # the global batch (the reference's 4x4 TorchDistributor shape,
+    # 2...py:460-470, at N=2 on localhost).
+    if args.train_data:
+        import contextlib
+        import io
+
+        from dss_ml_at_scale_tpu.config.cli import main as cli_main
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli_main([
+                "train", "--data", args.train_data, "--model", "tiny",
+                "--num-classes", "4", "--crop", "64", "--batch-size", "8",
+                "--epochs", "1", "--learning-rate", "0.01",
+            ])
+        result["train_rc"] = rc
+        if rc == 0:
+            summary = json.loads(buf.getvalue().strip().splitlines()[-1])
+            result["train_steps"] = summary["steps"]
+            result["train_loss"] = summary["train_loss"]
+        else:
+            # Surface the CLI's own output instead of dying on a parse of
+            # an empty buffer (which would also drop the earlier results).
+            result["train_output"] = buf.getvalue()[-2000:]
 
     # -- write result; filesystem barrier so neither process exits while
     #    the other still needs the jax.distributed service ----------------

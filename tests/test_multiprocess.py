@@ -1,10 +1,10 @@
-"""Real 2-process distributed smoke test.
+"""Real 2-process distributed tests.
 
 Everything else in the suite simulates multi-device on one process
-(conftest's 8 virtual CPU devices). This launches TWO actual OS
+(conftest's 8 virtual CPU devices). These tests launch TWO actual OS
 processes connected through ``jax.distributed`` on a localhost
 coordinator — the shape the reference runs as 4 nodes × 4 GPUs via
-``TorchDistributor`` (``deep_learning/2...py:460-470``) — and asserts:
+``TorchDistributor`` (``deep_learning/2...py:460-470``) — and assert:
 
 - both processes see the global topology (2 processes, 2 devices);
 - a jitted reduction over a process-spanning mesh produces the global
@@ -12,7 +12,9 @@ coordinator — the shape the reference runs as 4 nodes × 4 GPUs via
 - ``cur_shard/shard_count`` reader shards cover the table disjointly
   across *processes* (not just simulated devices);
 - a ``HostTrials`` sweep driven from process 0 evaluates trials on a
-  worker served by process 1 (control plane crosses the boundary).
+  worker served by process 1 (control plane crosses the boundary);
+- (slow) a full multi-host ``dsst train`` epoch: per-process reader
+  shards assembled into the global batch on a process-spanning mesh.
 """
 
 import json
@@ -35,13 +37,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_smoke(tmp_path):
-    from dss_ml_at_scale_tpu.data import write_delta
-
-    table = pa.table({"id": pa.array(np.arange(16, dtype=np.int64))})
-    data = tmp_path / "table"
-    write_delta(table, data, max_rows_per_file=4)
-
+def _launch_pair(tmp_path, data, extra_args=()):
     # The parent pytest process forces 8 simulated devices via XLA_FLAGS;
     # children must not inherit that (1 CPU device per process).
     env = dict(os.environ)
@@ -64,6 +60,7 @@ def test_two_process_distributed_smoke(tmp_path):
                 "--process-id", str(pid),
                 "--data", str(data),
                 "--workdir", str(tmp_path),
+                *extra_args,
             ],
             env=env,
             stdout=subprocess.PIPE,
@@ -83,10 +80,22 @@ def test_two_process_distributed_smoke(tmp_path):
                 p.kill()
     for p, out in zip(procs, outputs):
         assert p.returncode == 0, f"child failed:\n{out[-3000:]}"
-
-    results = [
+    return [
         json.loads((tmp_path / f"result_{i}.json").read_text()) for i in (0, 1)
     ]
+
+
+def _id_table(tmp_path):
+    from dss_ml_at_scale_tpu.data import write_delta
+
+    table = pa.table({"id": pa.array(np.arange(16, dtype=np.int64))})
+    data = tmp_path / "table"
+    write_delta(table, data, max_rows_per_file=4)
+    return data
+
+
+def test_two_process_distributed_smoke(tmp_path):
+    results = _launch_pair(tmp_path, _id_table(tmp_path))
     for r in results:
         assert r["process_count"] == 2
         assert r["global_devices"] == 2
@@ -100,3 +109,31 @@ def test_two_process_distributed_smoke(tmp_path):
     # The HPO sweep ran on the other process's worker.
     assert results[0]["hpo_ok_trials"] == 4
     assert -5.0 <= results[0]["hpo_best_x"] <= 5.0
+
+
+@pytest.mark.slow
+def test_two_process_training(tmp_path):
+    sys.path.insert(0, str(Path(__file__).parent))
+    from test_end_to_end import _jpeg
+
+    from dss_ml_at_scale_tpu.data import write_delta
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 64)
+    images = pa.table({
+        "content": pa.array([_jpeg(rng, l) for l in labels], type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    train_data = tmp_path / "images"
+    write_delta(images, train_data, max_rows_per_file=16)
+
+    results = _launch_pair(
+        tmp_path, _id_table(tmp_path),
+        extra_args=["--train-data", str(train_data)],
+    )
+    # Multi-host DP training: steps/epoch = rows // (batch x world)
+    # = 64 // (8 x 2) = 4, identical on both ranks, finite loss.
+    for r in results:
+        assert r["train_rc"] == 0
+        assert r["train_steps"] == 4
+        assert np.isfinite(r["train_loss"])
