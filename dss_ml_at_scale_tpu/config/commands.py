@@ -302,6 +302,12 @@ def register_train(sub: argparse._SubParsersAction) -> None:
         "replicating it (same math, ~world-size less optimizer memory)",
     )
     tr.add_argument(
+        "--image-dtype", choices=["float32", "uint8"], default="float32",
+        help="uint8 ships raw quantized bytes to the device (4x less host "
+        "RAM / queue memory / transfer) and normalizes inside the jitted "
+        "step; float32 normalizes on the host (torchvision parity)",
+    )
+    tr.add_argument(
         "--decode-backend", choices=["auto", "native", "pil"], default="auto",
         help="JPEG decode path: the C++ pool, pure-PIL, or auto (native "
         "when it compiles, per-image PIL fallback); the resolved backend "
@@ -337,7 +343,10 @@ def _cmd_train(args: argparse.Namespace) -> int:
 
     table = DeltaTable(args.data)
     rows = table.num_records()
-    spec = imagenet_transform_spec(crop=args.crop, backend=args.decode_backend)
+    spec = imagenet_transform_spec(
+        crop=args.crop, backend=args.decode_backend,
+        output_dtype=args.image_dtype,
+    )
     # Pretrained torchvision weights embed symmetric stride-2 padding in
     # their BatchNorm statistics; the model must match (models/pretrained.py).
     # The choice is persisted next to the checkpoint so a later --resume

@@ -110,6 +110,7 @@ def imagenet_transform_spec(
     backend: str = "auto",
     decode_threads: int | None = None,
     layout: str = "hwc",
+    output_dtype: str = "float32",
 ) -> TransformSpec:
     """The reference's training TransformSpec, columnar.
 
@@ -128,11 +129,28 @@ def imagenet_transform_spec(
     codecs the native path rejects (e.g. CMYK JPEGs). The resolved
     backend is exposed as ``spec.backend`` so harnesses can report what
     actually ran.
+
+    ``output_dtype="uint8"`` emits the raw quantized [0, 255] bytes —
+    4x less host RAM, queue memory, and host→device transfer than
+    float32 — and defers normalization to the device program
+    (``ClassifierTask`` normalizes uint8 batches inside the jitted step,
+    where XLA fuses it into the first conv). Requires ``normalize=True``
+    semantics downstream; ``normalize=False`` + uint8 is the same bytes.
     """
     if backend not in ("auto", "native", "pil"):
         raise ValueError(f"unknown backend {backend!r}")
     if layout not in ("hwc", "chw"):
         raise ValueError(f"unknown layout {layout!r}")
+    if output_dtype not in ("float32", "uint8"):
+        raise ValueError(f"unknown output_dtype {output_dtype!r}")
+    if output_dtype == "uint8" and not normalize:
+        # uint8 batches are ALWAYS normalized on device by the task; a
+        # normalize=False uint8 spec would silently train on different
+        # inputs than the float32 normalize=False path.
+        raise ValueError(
+            "output_dtype='uint8' defers normalization to the device step "
+            "and cannot express normalize=False; use float32 for raw values"
+        )
     if crop > resize:
         # crop > resize would mean padding/stretching, and the native and
         # PIL paths disagree on which; the reference never does it (256/224).
@@ -153,6 +171,9 @@ def imagenet_transform_spec(
 
     def _decode_pil(b: bytes) -> np.ndarray:
         img = decode_resize_crop(b, resize=resize, crop=crop, layout=layout)
+        if output_dtype == "uint8":
+            # Undo ToTensor's /255: recover the exact quantized bytes.
+            return np.round(img * 255.0).astype(np.uint8)
         if normalize:
             stats_shape = (1, 1, 3) if layout == "hwc" else (3, 1, 1)
             img = (img - IMAGENET_MEAN.reshape(stats_shape)) / IMAGENET_STD.reshape(
@@ -167,9 +188,10 @@ def imagenet_transform_spec(
                 jpegs,
                 resize=resize,
                 crop=crop,
-                mean=IMAGENET_MEAN if normalize else None,
-                std=IMAGENET_STD if normalize else None,
+                mean=IMAGENET_MEAN if normalize and output_dtype == "float32" else None,
+                std=IMAGENET_STD if normalize and output_dtype == "float32" else None,
                 chw=layout == "chw",
+                dtype=output_dtype,
                 num_threads=decode_threads,
             )
             if not ok.all():
@@ -187,7 +209,7 @@ def imagenet_transform_spec(
     return TransformSpec(
         func=_func,
         fields=[
-            Field("image", np.dtype(np.float32), image_shape),
+            Field("image", np.dtype(output_dtype), image_shape),
             Field("label", np.dtype(np.int32), ()),
         ],
         backend="native" if use_native else "pil",

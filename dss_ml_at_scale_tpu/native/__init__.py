@@ -24,7 +24,7 @@ import numpy as np
 _SRC = Path(__file__).with_name("image_pipeline.cpp")
 _LIB = Path(__file__).with_name("libdsst_image.so")
 _HASH = Path(__file__).with_name("libdsst_image.srchash")
-_ABI = 1
+_ABI = 2
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -104,7 +104,8 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_float),
                 ctypes.POINTER(ctypes.c_float),
                 ctypes.c_int,
-                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int,
+                ctypes.c_void_p,
                 ctypes.c_int,
                 ctypes.POINTER(ctypes.c_int),
             ]
@@ -133,24 +134,36 @@ def decode_jpeg_batch(
     mean: np.ndarray | None = None,
     std: np.ndarray | None = None,
     chw: bool = True,
+    dtype: str = "float32",
     num_threads: int | None = None,  # default: one pool of cpu_count threads;
     # callers running several decode batches concurrently should divide the
     # host's cores among themselves to avoid oversubscription
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Decode a batch of JPEG byte strings into a float32 image tensor.
+    """Decode a batch of JPEG byte strings into an image tensor.
 
     Returns ``(images, ok)`` where ``images`` has shape ``[n,3,crop,crop]``
     (or HWC with ``chw=False``) and ``ok`` is a boolean mask; failed rows
     are zero-filled and should be re-decoded by the caller's fallback.
-    Pass ``mean``/``std`` (3-vectors) to fuse normalization into the
-    native pass; otherwise values are in [0, 1].
+
+    ``dtype="float32"``: values in [0, 1], or normalized when
+    ``mean``/``std`` (3-vectors) are given — the torchvision-parity path.
+    ``dtype="uint8"``: the raw quantized [0, 255] bytes, 4x less memory
+    per image; normalization then belongs to the device program
+    (``mean``/``std`` must be None).
     """
     lib = _load()
     if lib is None:
         raise RuntimeError(_load_error or "native pipeline unavailable")
+    if dtype not in ("float32", "uint8"):
+        raise ValueError(f"dtype must be 'float32' or 'uint8', got {dtype!r}")
+    out_u8 = dtype == "uint8"
+    if out_u8 and (mean is not None or std is not None):
+        raise ValueError(
+            "uint8 output is raw [0,255]; normalize on device, not here"
+        )
     n = len(jpegs)
     shape = (n, 3, crop, crop) if chw else (n, crop, crop, 3)
-    out = np.zeros(shape, np.float32)
+    out = np.zeros(shape, np.uint8 if out_u8 else np.float32)
     if n == 0:
         return out, np.zeros(0, bool)
 
@@ -171,7 +184,8 @@ def decode_jpeg_batch(
         mean_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         std_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         int(chw),
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(out_u8),
+        out.ctypes.data_as(ctypes.c_void_p),
         int(num_threads),
         statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
     )

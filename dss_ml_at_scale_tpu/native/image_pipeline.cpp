@@ -183,10 +183,12 @@ void resize_crop(const uint8_t* src, int w, int h, int ow, int oh, int left,
 // `round(w * scale)` output-size computation.
 int round_half_even(double v) { return static_cast<int>(std::nearbyint(v)); }
 
-// Process one image end to end into out (float32, CHW or HWC, crop×crop).
+// Process one image end to end into outf (float32) or out8 (uint8, raw
+// quantized [0,255] — device-side normalization path); exactly one of the
+// two output pointers is non-null. CHW or HWC, crop×crop.
 bool process_one(const unsigned char* jpeg, unsigned long size, int resize_to,
                  int crop, bool do_norm, const float* mean, const float* stdv,
-                 bool chw, float* out) {
+                 bool chw, float* outf, uint8_t* out8) {
   std::vector<uint8_t> rgb;
   int w = 0, h = 0;
   if (!decode_rgb(jpeg, size, &rgb, &w, &h)) return false;
@@ -210,11 +212,15 @@ bool process_one(const unsigned char* jpeg, unsigned long size, int resize_to,
         // before ToTensor's /255; reproduce that quantization exactly.
         float q = std::nearbyint(srow[x * 3 + c]);
         q = std::min(255.f, std::max(0.f, q));
-        float v = q * inv255;
-        if (do_norm) v = (v - mean[c]) / stdv[c];
         size_t idx = chw ? (static_cast<size_t>(c) * crop + y) * crop + x
                          : (static_cast<size_t>(y) * crop + x) * 3 + c;
-        out[idx] = v;
+        if (out8 != nullptr) {
+          out8[idx] = static_cast<uint8_t>(q);
+        } else {
+          float v = q * inv255;
+          if (do_norm) v = (v - mean[c]) / stdv[c];
+          outf[idx] = v;
+        }
       }
     }
   }
@@ -225,16 +231,25 @@ bool process_one(const unsigned char* jpeg, unsigned long size, int resize_to,
 
 extern "C" {
 
-// Decode+transform a batch of JPEGs into a preallocated float32 tensor of
-// shape [n, 3, crop, crop] (chw=1) or [n, crop, crop, 3] (chw=0).
+// Decode+transform a batch of JPEGs into a preallocated tensor of shape
+// [n, 3, crop, crop] (chw=1) or [n, crop, crop, 3] (chw=0). out_u8=0
+// writes float32 (optionally normalized); out_u8=1 writes raw quantized
+// uint8 [0,255] (do_norm must be 0 — normalization then belongs to the
+// device program, which cuts host->device transfer 4x).
 // statuses[i]: 0 = ok, 1 = decode/transform failed (caller may fall back).
 // Returns the number of failures.
 int dsst_decode_batch(const unsigned char* const* jpegs,
                       const unsigned long* sizes, int n, int resize_to,
                       int crop, int do_norm, const float* mean,
-                      const float* stdv, int chw, float* out, int n_threads,
-                      int* statuses) {
+                      const float* stdv, int chw, int out_u8, void* out,
+                      int n_threads, int* statuses) {
   if (n <= 0) return 0;
+  if (out_u8 && do_norm) {
+    // Invalid combination: fail every row THROUGH the statuses contract
+    // (callers derive per-row success from statuses, not the return).
+    for (int i = 0; i < n; ++i) statuses[i] = 1;
+    return n;
+  }
   size_t per_image = static_cast<size_t>(crop) * crop * 3;
   std::atomic<int> next(0), failures(0);
   auto worker = [&]() {
@@ -243,8 +258,13 @@ int dsst_decode_batch(const unsigned char* const* jpegs,
       if (i >= n) return;
       bool ok;
       try {
+        float* outf = out_u8 ? nullptr
+                             : static_cast<float*>(out) + per_image * i;
+        uint8_t* out8 = out_u8
+                            ? static_cast<uint8_t*>(out) + per_image * i
+                            : nullptr;
         ok = process_one(jpegs[i], sizes[i], resize_to, crop, do_norm != 0,
-                         mean, stdv, chw != 0, out + per_image * i);
+                         mean, stdv, chw != 0, outf, out8);
       } catch (...) {
         // Per-image failure contract: an escaped exception (e.g. bad_alloc
         // on a pathological image) must flag the row, not terminate().
@@ -267,6 +287,6 @@ int dsst_decode_batch(const unsigned char* const* jpegs,
 }
 
 // Tiny ABI check so the Python binding can verify it loaded the right .so.
-int dsst_abi_version() { return 1; }
+int dsst_abi_version() { return 2; }
 
 }  // extern "C"

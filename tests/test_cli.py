@@ -98,6 +98,8 @@ def test_train_cli_tiny(tmp_path, capsys, devices8):
         "train", "--data", str(data), "--model", "tiny",
         "--num-classes", "4", "--crop", "64", "--batch-size", "16",
         "--epochs", "1", "--learning-rate", "0.01",
+        # uint8 device-transfer mode: raw bytes to HBM, normalize in-step.
+        "--image-dtype", "uint8",
     ]) == 0
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert summary["steps"] == 4  # 64 rows // 16

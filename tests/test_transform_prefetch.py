@@ -68,3 +68,46 @@ def test_prefetch_to_mesh_shards_batches(devices8):
 def test_prefetch_depth_validation(devices8):
     with pytest.raises(ValueError):
         list(prefetch_to_mesh(iter([]), make_mesh(), depth=0))
+
+
+def test_uint8_output_dtype_matches_float_path():
+    # uint8 spec emits the exact quantized bytes; dividing by 255 and
+    # normalizing must reproduce the float32 spec bit-for-bit (same
+    # quantization point in both paths).
+    batch = {
+        "content": np.array([_jpeg(300, 260), _jpeg(260, 300, (0, 0, 255))],
+                            dtype=object),
+        "label_index": np.array([0, 1]),
+    }
+    f32 = imagenet_transform_spec(output_dtype="float32")(dict(batch))
+    u8 = imagenet_transform_spec(output_dtype="uint8")(dict(batch))
+    assert u8["image"].dtype == np.uint8
+    renorm = (u8["image"].astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+    np.testing.assert_allclose(renorm, f32["image"], rtol=0, atol=1e-6)
+
+
+def test_uint8_task_normalizes_on_device(devices8):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dss_ml_at_scale_tpu.parallel import ClassifierTask
+    from test_models import tiny_resnet
+
+    task = ClassifierTask(model=tiny_resnet(num_classes=4), tx=optax.adam(1e-3))
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 256, (4, 32, 32, 3)).astype(np.uint8)
+    labels = np.array([0, 1, 2, 3], np.int32)
+    batch_u8 = {"image": raw, "label": labels}
+    batch_f32 = {
+        "image": ((raw.astype(np.float32) / 255.0 - IMAGENET_MEAN)
+                  / IMAGENET_STD),
+        "label": labels,
+    }
+    state = task.init_state(jax.random.key(0), batch_f32)
+    _, m_u8 = task.train_step(state, batch_u8)
+    _, m_f32 = task.train_step(state, batch_f32)
+    assert float(m_u8["train_loss"]) == pytest.approx(
+        float(m_f32["train_loss"]), rel=1e-5
+    )
+    assert jnp.isfinite(m_u8["train_loss"])
