@@ -61,9 +61,21 @@ class TransformerBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     mlp_ratio: int = 4
     attention_fn: Callable = None  # bound by TransformerLM
+    # "dense" | "moe" — MoE swaps the MLP for an expert-parallel
+    # MoEMLP (models/moe.py) routed top-1 over num_experts.
+    ffn: str = "dense"
+    num_experts: int = 0
+    capacity_factor: float = 1.25
+    expert_mesh: Any = None
+    expert_axis: str = "expert"
+    router_noise: float = 0.0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, deterministic: bool = True):
+        if self.ffn not in ("dense", "moe"):
+            raise ValueError(f"unknown ffn {self.ffn!r}: expected 'dense' or 'moe'")
+        if self.ffn == "moe" and self.num_experts < 1:
+            raise ValueError("ffn='moe' requires num_experts >= 1")
         b, s, dim = x.shape
         head_dim = dim // self.num_heads
 
@@ -79,9 +91,23 @@ class TransformerBlock(nn.Module):
         x = x + nn.Dense(dim, use_bias=False, dtype=self.dtype, name="proj")(attn)
 
         h = RMSNorm(dtype=self.dtype)(x)
-        h = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype, name="mlp_up")(h)
-        h = nn.gelu(h)
-        x = x + nn.Dense(dim, dtype=self.dtype, name="mlp_down")(h)
+        if self.ffn == "moe":
+            from .moe import MoEMLP
+
+            x = x + MoEMLP(
+                num_experts=self.num_experts,
+                mlp_ratio=self.mlp_ratio,
+                capacity_factor=self.capacity_factor,
+                dtype=self.dtype,
+                mesh=self.expert_mesh,
+                axis_name=self.expert_axis,
+                router_noise=self.router_noise,
+                name="moe",
+            )(h, deterministic=deterministic)
+        else:
+            h = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype, name="mlp_up")(h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(dim, dtype=self.dtype, name="mlp_down")(h)
         return x
 
 
@@ -102,9 +128,21 @@ class TransformerLM(nn.Module):
     attention: str = "flash"
     mesh: Any = None
     axis_name: str | None = None
+    # Expert-parallel MoE FFN (models/moe.py): ffn="moe" with
+    # num_experts > 0 swaps every block's MLP; expert_mesh/expert_axis
+    # shard the experts (EP) — None runs the same program on one device.
+    ffn: str = "dense"
+    num_experts: int = 0
+    capacity_factor: float = 1.25
+    expert_mesh: Any = None
+    expert_axis: str = "expert"
+    # Router jitter std at train time; needs an apply-time "router" rng
+    # and deterministic=False to take effect.
+    router_noise: float = 0.0
 
     @nn.compact
-    def __call__(self, tokens):  # [b, s] int32 -> [b, s, vocab] f32 logits
+    def __call__(self, tokens, *, deterministic: bool = True):
+        # [b, s] int32 -> [b, s, vocab] f32 logits
         b, s = tokens.shape
         if s > self.max_seq:
             raise ValueError(f"seq {s} > max_seq {self.max_seq}")
@@ -124,8 +162,14 @@ class TransformerLM(nn.Module):
                 dtype=self.dtype,
                 mlp_ratio=self.mlp_ratio,
                 attention_fn=attention_fn,
+                ffn=self.ffn,
+                num_experts=self.num_experts,
+                capacity_factor=self.capacity_factor,
+                expert_mesh=self.expert_mesh,
+                expert_axis=self.expert_axis,
+                router_noise=self.router_noise,
                 name=f"block_{i}",
-            )(x)
+            )(x, deterministic=deterministic)
         x = RMSNorm(dtype=self.dtype)(x)
         # Logits in f32 for a stable softmax cross-entropy.
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head")(x)

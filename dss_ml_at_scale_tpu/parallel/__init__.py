@@ -1,7 +1,13 @@
 """Distribution strategies: DP trainer, HPO executor, group-apply engine,
-ring attention (sequence parallelism)."""
+ring attention (sequence parallelism), GPipe-style pipeline parallelism."""
 
 from .ring import ring_attention  # noqa: F401
+from .pipeline import (  # noqa: F401
+    pipeline_utilization,
+    spmd_pipeline,
+    stack_stage_params,
+    stage_sharding,
+)
 
 from .trainer import (  # noqa: F401
     ClassifierTask,

@@ -1,0 +1,170 @@
+"""Pipeline parallelism: GPipe-style SPMD microbatch pipeline over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3 — its only
+gradient parallelism is DDP data parallel), but the framework's sharding
+layer is mesh-based precisely so every parallelism family falls out of
+the same mechanism. This module adds the PP column: N sequential stages
+laid out over a ``"pipe"`` mesh axis, microbatches streamed through with
+one ``lax.ppermute`` hop per tick riding the ICI ring.
+
+Design (the standard TPU SPMD pipeline schedule):
+
+- Stage parameters are *stacked* on a leading stage dimension and sharded
+  over the pipe axis — device i holds only stage i's weights. There is no
+  per-stage program: every device runs the SAME jitted computation
+  (SPMD), applying its resident stage to whatever activation is currently
+  in flight on it.
+- A scan over ``n_micro + n_stages - 1`` ticks drives the schedule.
+  Each tick: device 0 ingests the next microbatch, every device applies
+  its stage, the last device banks its finished microbatch, and all
+  activations shift one hop along the ring (``ppermute``). The first
+  ``n_stages - 1`` ticks are the classic GPipe bubble: utilization is
+  ``n_micro / (n_micro + n_stages - 1)``, so callers pick
+  ``n_micro >> n_stages``.
+- The whole schedule is reverse-differentiable: ``ppermute``'s transpose
+  is the reverse ppermute, so ``jax.grad`` through the pipeline yields
+  the 1F1B-style backward sweep automatically — gradients visit stages
+  in reverse order over the same ring, with XLA overlapping the hop with
+  each stage's backward matmuls. Each stage application is wrapped in
+  ``jax.checkpoint`` so the backward pass rematerializes stage compute
+  instead of storing every tick's activations.
+
+``spmd_pipeline`` is deliberately functional — ``stage_fn(params, x)``
+is any jittable per-stage function (a Flax ``Module.apply`` bound to
+stacked params, a bare matmul, a transformer block) — and composes with
+data parallelism via ``batch_axis``: on a ``{"pipe": P, "data": D}``
+mesh the within-microbatch batch dimension is sharded over "data", so
+each of the D columns pipelines its own batch shard (PP × DP).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def stack_stage_params(init_fn: Callable[[jax.Array], Any], rng: jax.Array,
+                       n_stages: int):
+    """Initialize ``n_stages`` independent stage params, stacked on axis 0.
+
+    ``init_fn(rng) -> pytree`` initializes ONE stage; the result's leaves
+    gain a leading ``[n_stages, ...]`` dimension, ready to shard over the
+    pipe axis with :func:`stage_sharding`.
+    """
+    return jax.vmap(init_fn)(jax.random.split(rng, n_stages))
+
+
+def stage_sharding(params: Any, mesh: Mesh, axis_name: str = "pipe"):
+    """NamedSharding tree placing each stacked leaf's stage dim on the axis."""
+    def leaf(l):
+        ndim = getattr(l, "ndim", 0)
+        if ndim < 1:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, P(*([axis_name] + [None] * (ndim - 1))))
+
+    return jax.tree_util.tree_map(leaf, params)
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: Mesh,
+    axis_name: str = "pipe",
+    batch_axis: str | None = None,
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Build ``run(stacked_params, microbatches) -> outputs``.
+
+    ``stacked_params``: pytree with a leading stage dimension of size
+    ``mesh.shape[axis_name]`` on every array leaf (see
+    :func:`stack_stage_params`), sharded or shardable over the axis.
+
+    ``microbatches``: ``[n_micro, micro_batch, ...]`` activations; the
+    output has the same shape after every microbatch passed through all
+    stages in order. ``stage_fn`` must preserve the activation shape
+    (equal widths — the GPipe regime; unequal-width stages belong to
+    tensor sharding, not the pipeline).
+
+    ``batch_axis``: optional second mesh axis carrying data parallelism —
+    the per-microbatch batch dimension (``microbatches`` axis 1) is
+    sharded over it, so a ``{"pipe": P, "data": D}`` mesh runs D
+    batch-shards through P stages concurrently (PP × DP). When None the
+    activations are replicated over every non-pipe axis.
+    """
+    n = mesh.shape[axis_name]
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    checkpointed = jax.checkpoint(stage_fn)
+
+    def local(stacked, xs):
+        # stacked leaves arrive as [1, ...] local shards — drop the stage dim.
+        params = jax.tree_util.tree_map(lambda l: l[0], stacked)
+        idx = jax.lax.axis_index(axis_name)
+        n_micro = xs.shape[0]
+        state = jnp.zeros_like(xs[0])
+        ys = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            state, ys = carry
+            # Device 0 ingests microbatch t (a clipped gather keeps the
+            # index in range through the drain ticks; the value is unused
+            # once t >= n_micro because those outputs are never banked).
+            x_in = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+            )
+            state = jnp.where(idx == 0, x_in, state)
+            out = checkpointed(params, state)
+            # After applying stage ``idx`` at tick t, device idx holds
+            # microbatch t - idx processed through stages 0..idx; the last
+            # device therefore banks microbatch t - (n-1).
+            t_out = t - (n - 1)
+            banked = jax.lax.dynamic_update_index_in_dim(
+                ys, out, jnp.clip(t_out, 0, n_micro - 1), 0
+            )
+            ys = jnp.where((idx == n - 1) & (t_out >= 0), banked, ys)
+            state = jax.lax.ppermute(out, axis_name, fwd)
+            return (state, ys), None
+
+        (state, ys), _ = jax.lax.scan(
+            tick, (state, ys), jnp.arange(n_micro + n - 1)
+        )
+        # Only the last stage holds real outputs; the masked psum over the
+        # pipe axis broadcasts them so the result is replicated along
+        # "pipe" (and stays sharded over ``batch_axis`` if one was given).
+        return jax.lax.psum(
+            jnp.where(idx == n - 1, ys, jnp.zeros_like(ys)), axis_name
+        )
+
+    stage_spec = P(axis_name)  # leading stage dim on every leaf
+    # Microbatch activations: replicated along the pipe axis, optionally
+    # batch-sharded over ``batch_axis`` (axis 1 = within-microbatch batch).
+    io_spec = P(None, batch_axis) if batch_axis is not None else P()
+
+    def run(stacked, xs):
+        specs = (
+            jax.tree_util.tree_map(lambda _: stage_spec, stacked),
+            io_spec,
+        )
+        try:  # jax >= 0.8 renamed check_rep -> check_vma
+            fn = shard_map(
+                local, mesh=mesh, in_specs=specs, out_specs=io_spec,
+                check_vma=False,
+            )
+        except TypeError:  # pragma: no cover - older jax
+            fn = shard_map(
+                local, mesh=mesh, in_specs=specs, out_specs=io_spec,
+                check_rep=False,
+            )
+        return fn(stacked, xs)
+
+    return run
+
+
+def pipeline_utilization(n_micro: int, n_stages: int) -> float:
+    """GPipe bubble accounting: fraction of ticks doing useful work."""
+    return n_micro / (n_micro + n_stages - 1)

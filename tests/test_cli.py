@@ -78,8 +78,11 @@ def test_forecast_end_to_end(tmp_path, capsys, devices8):
 
 
 @pytest.mark.slow
-def test_train_cli_tiny(tmp_path, capsys, devices8):
+@pytest.mark.parametrize("image_dtype", ["float32", "uint8"])
+def test_train_cli_tiny(tmp_path, capsys, devices8, image_dtype):
     # Reuse the end-to-end fixture recipe: tiny JPEG Delta table.
+    # Covers both device-transfer modes: host-normalized float32 (default)
+    # and raw uint8 bytes normalized inside the jitted step.
     from test_end_to_end import _jpeg
     import pyarrow as pa
 
@@ -98,8 +101,7 @@ def test_train_cli_tiny(tmp_path, capsys, devices8):
         "train", "--data", str(data), "--model", "tiny",
         "--num-classes", "4", "--crop", "64", "--batch-size", "16",
         "--epochs", "1", "--learning-rate", "0.01",
-        # uint8 device-transfer mode: raw bytes to HBM, normalize in-step.
-        "--image-dtype", "uint8",
+        "--image-dtype", image_dtype,
     ]) == 0
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert summary["steps"] == 4  # 64 rows // 16
