@@ -202,6 +202,11 @@ def register_eda(sub: argparse._SubParsersAction) -> None:
     eda.add_argument("--max-evals", type=int, default=10)
     eda.add_argument("--parallelism", type=int, default=10)
     eda.add_argument("--max-iter", type=int, default=200)
+    eda.add_argument(
+        "--polish", action="store_true",
+        help="refine the single-SKU SARIMAX fits with the host-side "
+        "float64 polish (closes the f32 unit-root corner)",
+    )
     eda.set_defaults(fn=_cmd_eda)
 
 
@@ -220,6 +225,7 @@ def _cmd_eda(args: argparse.Namespace) -> int:
         max_evals=args.max_evals,
         parallelism=args.parallelism,
         cfg=SarimaxConfig(k_exog=len(EXO_FIELDS), max_iter=args.max_iter),
+        polish=args.polish,
     )
     print(f"EDA for Product={report.product} SKU={report.sku} "
           f"(holdout {args.horizon} weeks)")
@@ -485,6 +491,148 @@ def _has_checkpoint(args: argparse.Namespace) -> bool:
 
 
 # --------------------------------------------------------------------------
+# lm (beyond parity: transformer LM on the same Trainer machinery)
+# --------------------------------------------------------------------------
+
+def register_lm(sub: argparse._SubParsersAction) -> None:
+    lm = sub.add_parser(
+        "lm",
+        help="train a Transformer LM on a synthetic Markov token stream "
+        "(flash attention; optional expert-parallel MoE FFN)",
+    )
+    lm.add_argument("--vocab", type=int, default=256)
+    lm.add_argument("--dim", type=int, default=128)
+    lm.add_argument("--heads", type=int, default=4)
+    lm.add_argument("--layers", type=int, default=2)
+    lm.add_argument("--seq", type=int, default=128)
+    lm.add_argument("--batch-size", type=int, default=8)
+    lm.add_argument("--epochs", type=int, default=2)
+    lm.add_argument("--steps-per-epoch", type=int, default=50)
+    lm.add_argument("--learning-rate", type=float, default=3e-4)
+    lm.add_argument(
+        "--attention", choices=["flash", "reference"], default="flash",
+        help="single-chip attention backend; the sequence-parallel ring "
+        "path is exercised via the API / driver dry run (it needs a "
+        "sequence-sharded mesh, not a batch-sharded one)",
+    )
+    lm.add_argument(
+        "--ffn", choices=["dense", "moe"], default="dense",
+        help="moe swaps every block's MLP for a top-1 routed "
+        "mixture-of-experts (models/moe.py) with the load-balance aux "
+        "loss folded into the objective; experts are sharded over the "
+        "mesh (EP) when --num-experts divides the device count, else "
+        "replicated",
+    )
+    lm.add_argument("--num-experts", type=int, default=8)
+    lm.add_argument("--aux-loss-weight", type=float, default=0.01)
+    lm.add_argument(
+        "--concentration", type=float, default=0.05,
+        help="Dirichlet concentration of the Markov source's transition "
+        "rows; lower = more predictable = lower entropy floor",
+    )
+    lm.add_argument("--seed", type=int, default=0)
+    lm.add_argument("--limit-val-batches", type=int, default=5)
+    lm.add_argument("--checkpoint-dir", default=None)
+    lm.add_argument("--resume", action="store_true")
+    lm.add_argument("--experiment", default="lm")
+    lm.add_argument("--tracking-root", default=None)
+    lm.set_defaults(fn=_cmd_lm)
+
+
+def _cmd_lm(args: argparse.Namespace) -> int:
+    import optax
+
+    from ..datagen.tokens import TokenStreamConfig, entropy_floor, token_batches
+    from ..models import TransformerLM
+    from ..parallel import LMTask, Trainer, TrainerConfig
+    from ..runtime import make_mesh
+
+    stream = TokenStreamConfig(
+        vocab_size=args.vocab,
+        batch_size=args.batch_size,
+        seq_len=args.seq,
+        concentration=args.concentration,
+        seed=args.seed,
+    )
+    floor = entropy_floor(stream)
+
+    mesh = make_mesh()
+    # Expert parallelism rides the same devices as DP: expert-dimension
+    # operands are sharding-constrained over the "data" axis when the
+    # expert count divides it (models/moe.py inserts the all-to-alls).
+    n_dev = mesh.shape["data"]
+    shard_experts = (
+        args.ffn == "moe" and n_dev > 1 and args.num_experts % n_dev == 0
+    )
+    model = TransformerLM(
+        vocab_size=args.vocab,
+        dim=args.dim,
+        num_heads=args.heads,
+        num_layers=args.layers,
+        max_seq=args.seq,
+        attention=args.attention,
+        ffn=args.ffn,
+        num_experts=args.num_experts if args.ffn == "moe" else 0,
+        expert_mesh=mesh if shard_experts else None,
+        expert_axis="data",
+    )
+    task = LMTask(
+        model=model,
+        tx=optax.adam(args.learning_rate),
+        aux_loss_weight=args.aux_loss_weight if args.ffn == "moe" else 0.0,
+    )
+
+    tracker = None
+    if args.tracking_root:
+        from ..tracking import RunStore
+
+        tracker = RunStore(args.tracking_root, args.experiment, run_name="lm")
+        tracker.log_params(
+            {k: v for k, v in vars(args).items() if k != "fn" and v is not None}
+        )
+        tracker.log_params({"entropy_floor": floor})
+
+    trainer = Trainer(
+        TrainerConfig(
+            max_epochs=args.epochs,
+            steps_per_epoch=args.steps_per_epoch,
+            limit_val_batches=args.limit_val_batches,
+            checkpoint_dir=args.checkpoint_dir,
+            resume=args.resume,
+        ),
+        mesh=mesh,
+        tracker=tracker,
+    )
+
+    # Eval split: a fresh sample path of the SAME chain (sample_seed only
+    # reseeds the trajectory, not the transition matrix).
+    result = trainer.fit(
+        task,
+        token_batches(stream),
+        val_data_factory=lambda: token_batches(
+            stream, num_batches=args.limit_val_batches,
+            sample_seed=args.seed + 1000,
+        ),
+    )
+    if tracker is not None:
+        tracker.finish()
+    last = result.history[-1] if result.history else {}
+    print(
+        json.dumps(
+            {
+                "steps": int(result.state.step),
+                "train_loss": last.get("train_loss"),
+                "val_loss": last.get("val_loss"),
+                "val_ppl": last.get("val_ppl"),
+                "entropy_floor_nats": round(floor, 4),
+                "best_checkpoint": result.best_checkpoint_path,
+            }
+        )
+    )
+    return 0
+
+
+# --------------------------------------------------------------------------
 # hpo (the data-size playbook demo)
 # --------------------------------------------------------------------------
 
@@ -643,6 +791,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_eda(sub)
     register_ingest(sub)
     register_train(sub)
+    register_lm(sub)
     register_hpo(sub)
     register_trial_worker(sub)
     from .pipeline import register_pipeline

@@ -1,0 +1,94 @@
+"""Synthetic token streams for the LM track: seeded Markov chains.
+
+The reference has no language workload (SURVEY.md §5.7); the LM track is
+the framework's beyond-parity long-context family. Like the demand
+generator (``datagen/demand.py`` — the reference's fixture-as-generator
+pattern, SURVEY.md §4.4), this module IS the LM fixture: an order-1
+Markov source whose per-row transition entropy is a computable
+cross-entropy floor, so "the model learns" is a checkable claim
+(loss → floor) rather than "loss went down".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class TokenStreamConfig:
+    vocab_size: int = 256
+    batch_size: int = 8
+    seq_len: int = 128
+    # Dirichlet concentration of each transition row: lower = peakier
+    # rows = more predictable chain = lower entropy floor.
+    concentration: float = 0.05
+    seed: int = 0
+
+
+def transition_matrix(cfg: TokenStreamConfig) -> np.ndarray:
+    """The chain's row-stochastic transition matrix [V, V] (seeded)."""
+    rng = np.random.default_rng(cfg.seed)
+    t = rng.dirichlet(
+        np.full(cfg.vocab_size, cfg.concentration), size=cfg.vocab_size
+    )
+    return t.astype(np.float64)
+
+
+def entropy_floor(cfg: TokenStreamConfig) -> float:
+    """Expected next-token cross entropy (nats) of the optimal predictor.
+
+    The stationary-weighted row entropy of the transition matrix: no
+    model can beat it, and a converged LM approaches it.
+    """
+    t = transition_matrix(cfg)
+    # Stationary distribution via power iteration (rows sum to 1).
+    pi = np.full(cfg.vocab_size, 1.0 / cfg.vocab_size)
+    for _ in range(200):
+        nxt = pi @ t
+        if np.abs(nxt - pi).max() < 1e-12:
+            break
+        pi = nxt
+    with np.errstate(divide="ignore", invalid="ignore"):
+        row_entropy = -np.sum(np.where(t > 0, t * np.log(t), 0.0), axis=1)
+    return float(pi @ row_entropy)
+
+
+def token_batches(
+    cfg: TokenStreamConfig,
+    num_batches: int | None = None,
+    sample_seed: int | None = None,
+) -> Iterator[dict]:
+    """Yield ``{"tokens": int32 [batch, seq]}`` batches from the chain.
+
+    ``num_batches=None`` streams forever (the reader-semantics match of
+    ``num_epochs=None``); a finite count makes an eval split.
+
+    ``sample_seed`` seeds the SAMPLE PATH only — the transition matrix
+    always comes from ``cfg.seed``, so train (default) and eval
+    (``sample_seed=...``) splits draw different trajectories of the SAME
+    chain.
+    """
+    t32 = transition_matrix(cfg).astype(np.float32)
+    cum = np.cumsum(t32, axis=1)
+    rng = np.random.default_rng(
+        cfg.seed + 1 if sample_seed is None else sample_seed
+    )
+    count = 0
+    while num_batches is None or count < num_batches:
+        tokens = np.empty((cfg.batch_size, cfg.seq_len), np.int32)
+        state = rng.integers(0, cfg.vocab_size, cfg.batch_size)
+        tokens[:, 0] = state
+        # Vectorized over the batch: one inverse-CDF draw per position.
+        u = rng.random((cfg.batch_size, cfg.seq_len - 1), np.float32)
+        for pos in range(1, cfg.seq_len):
+            # Inverse-CDF draw; the clip guards f32 rows summing to <1.
+            state = np.minimum(
+                (cum[state] < u[:, pos - 1, None]).sum(axis=1),
+                cfg.vocab_size - 1,
+            ).astype(np.int32)
+            tokens[:, pos] = state
+        yield {"tokens": tokens}
+        count += 1

@@ -1,0 +1,22 @@
+"""jax-version compatibility shims shared by the shard_map-based strategies."""
+
+from __future__ import annotations
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def shard_map_unchecked(fn, *, mesh, in_specs, out_specs):
+    """``shard_map`` with replication checking off across jax versions.
+
+    The flag was renamed ``check_rep`` → ``check_vma`` in jax 0.8; both
+    ring attention and the GPipe pipeline need it off (their per-device
+    programs are deliberately non-replicated along the strategy axis).
+    """
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return shard_map(fn, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover - older jax
+        return shard_map(fn, check_rep=False, **kwargs)

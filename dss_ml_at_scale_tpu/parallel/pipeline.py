@@ -45,10 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+from ._compat import shard_map_unchecked
 
 
 def stack_stage_params(init_fn: Callable[[jax.Array], Any], rng: jax.Array,
@@ -150,16 +147,9 @@ def spmd_pipeline(
             jax.tree_util.tree_map(lambda _: stage_spec, stacked),
             io_spec,
         )
-        try:  # jax >= 0.8 renamed check_rep -> check_vma
-            fn = shard_map(
-                local, mesh=mesh, in_specs=specs, out_specs=io_spec,
-                check_vma=False,
-            )
-        except TypeError:  # pragma: no cover - older jax
-            fn = shard_map(
-                local, mesh=mesh, in_specs=specs, out_specs=io_spec,
-                check_rep=False,
-            )
+        fn = shard_map_unchecked(
+            local, mesh=mesh, in_specs=specs, out_specs=io_spec
+        )
         return fn(stacked, xs)
 
     return run

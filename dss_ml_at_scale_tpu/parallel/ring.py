@@ -41,10 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+from ._compat import shard_map_unchecked
 
 _NEG_INF = -1e30
 
@@ -139,9 +136,7 @@ def ring_attention(
         raise ValueError("ring attention requires sq == sk (self-attention)")
     spec = P(None, None, axis_name, None)
     local = functools.partial(_ring_local, axis_name=axis_name, causal=causal)
-    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    try:  # jax >= 0.8 renamed check_rep -> check_vma
-        fn = shard_map(local, check_vma=False, **kwargs)
-    except TypeError:  # pragma: no cover - older jax
-        fn = shard_map(local, check_rep=False, **kwargs)
+    fn = shard_map_unchecked(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
     return fn(q, k, v)

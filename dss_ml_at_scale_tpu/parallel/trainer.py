@@ -193,6 +193,9 @@ class LMTask:
     tx: optax.GradientTransformation | None = None
     learning_rate: float = 3e-4
     tokens_key: str = "tokens"
+    # MoE models sow a load-balance loss under intermediates/aux_loss
+    # (models/moe.py); a positive weight folds it into the objective.
+    aux_loss_weight: float = 0.0
 
     def __post_init__(self):
         if self.tx is None:
@@ -219,6 +222,14 @@ class LMTask:
         tokens = jnp.asarray(batch[self.tokens_key])
 
         def loss_fn(params):
+            if self.aux_loss_weight > 0.0:
+                from ..models.moe import collect_aux_loss
+
+                logits, inter = self.model.apply(
+                    {"params": params}, tokens, mutable=["intermediates"]
+                )
+                aux = collect_aux_loss(inter["intermediates"])
+                return next_token_loss(logits, tokens) + self.aux_loss_weight * aux
             logits = self.model.apply({"params": params}, tokens)
             return next_token_loss(logits, tokens)
 

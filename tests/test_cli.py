@@ -321,3 +321,25 @@ def test_hpo_remote_workers_cli(tmp_path, capsys):
 def test_hpo_remote_workers_requires_data(capsys):
     assert main(["hpo", "--workers", "127.0.0.1:1"]) == 2
     assert "requires --data" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("ffn", ["dense", "moe"])
+def test_lm_cli_tiny(capsys, devices8, ffn):
+    # Beyond-parity LM track through the CLI: a tiny transformer on the
+    # Markov stream must reach a val loss well under uniform log(V)
+    # within a few hundred steps (the entropy floor is far lower).
+    assert main([
+        "lm", "--vocab", "16", "--dim", "32", "--heads", "2",
+        "--layers", "1", "--seq", "32", "--batch-size", "8",
+        "--epochs", "2", "--steps-per-epoch", "60",
+        "--learning-rate", "0.01", "--attention", "reference",
+        # 8 experts over the 8 simulated devices: divisible, so the CLI
+        # enables expert sharding (EP) on the moe variant.
+        "--ffn", ffn, "--num-experts", "8",
+        "--concentration", "0.05",
+    ]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["steps"] == 120
+    assert summary["val_loss"] < 0.8 * np.log(16), summary
+    assert summary["entropy_floor_nats"] < summary["val_loss"]

@@ -117,3 +117,60 @@ def test_gen_data_sizing_and_tune():
     assert out["status"] == "ok" and np.isfinite(out["loss"])
     best_alpha = tune_alpha(lambda a: train_and_eval(data, a), max_evals=4)
     assert 0.0 <= best_alpha <= 10.0
+
+
+# ---------------------------------------------------------------------------
+# Synthetic Markov token streams (LM track fixture)
+# ---------------------------------------------------------------------------
+
+def test_token_stream_shapes_and_determinism():
+    from dss_ml_at_scale_tpu.datagen.tokens import (
+        TokenStreamConfig,
+        entropy_floor,
+        token_batches,
+        transition_matrix,
+    )
+
+    cfg = TokenStreamConfig(vocab_size=32, batch_size=4, seq_len=16, seed=7)
+    t = transition_matrix(cfg)
+    assert t.shape == (32, 32)
+    np.testing.assert_allclose(t.sum(axis=1), 1.0, atol=1e-12)
+
+    a = [b["tokens"].copy() for b in token_batches(cfg, num_batches=3)]
+    b = [b["tokens"].copy() for b in token_batches(cfg, num_batches=3)]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)  # seeded stream
+        assert x.shape == (4, 16) and x.dtype == np.int32
+        assert x.min() >= 0 and x.max() < 32
+
+    # Peaky rows (low concentration) must give a lower entropy floor than
+    # near-uniform rows, and both sit inside [0, log V].
+    lo = entropy_floor(TokenStreamConfig(vocab_size=32, concentration=0.02))
+    hi = entropy_floor(TokenStreamConfig(vocab_size=32, concentration=50.0))
+    assert 0.0 < lo < hi < np.log(32) + 1e-9
+
+
+def test_token_stream_is_learnable_markov():
+    # The empirical bigram distribution of a long stream must match the
+    # chain's transition matrix — i.e. the data really is the chain.
+    from dss_ml_at_scale_tpu.datagen.tokens import (
+        TokenStreamConfig,
+        token_batches,
+        transition_matrix,
+    )
+
+    cfg = TokenStreamConfig(
+        vocab_size=8, batch_size=16, seq_len=512, concentration=0.3, seed=3
+    )
+    t = transition_matrix(cfg)
+    counts = np.zeros((8, 8))
+    for batch in token_batches(cfg, num_batches=4):
+        toks = batch["tokens"]
+        for row in toks:
+            np.add.at(counts, (row[:-1], row[1:]), 1.0)
+    empirical = counts / np.maximum(counts.sum(axis=1, keepdims=True), 1.0)
+    visited = counts.sum(axis=1) > 200
+    assert visited.any()
+    np.testing.assert_allclose(
+        empirical[visited], t[visited], atol=0.08
+    )

@@ -49,6 +49,25 @@ def test_run_eda_report(devices8, demand_df):
     assert all(0 <= o <= 2 for o in report.best_order)
 
 
+@pytest.mark.slow
+def test_run_eda_polish(devices8, demand_df):
+    # polish=True routes the fixed-order SARIMAX fits through the f64
+    # host polish; scores stay finite and can only improve or match the
+    # f32 likelihoods' predictive quality up to optimizer noise.
+    report = run_eda(
+        demand_df,
+        horizon=20,
+        seasonal_periods=26,
+        max_evals=2,
+        parallelism=2,
+        cfg=CFG_SMALL,
+        polish=True,
+    )
+    by_model = dict(zip(report.scores["model"], report.scores["mse"]))
+    assert np.isfinite(by_model["sarimax_exog"])
+    assert np.isfinite(by_model["sarimax_no_exog"])
+
+
 def test_run_eda_short_series_raises(demand_df):
     small = extract_sku_series(demand_df).head(30)
     with pytest.raises(ValueError, match="holdout"):
