@@ -96,6 +96,9 @@ def main() -> int:
 
     import jax
 
+    from bench import _enable_compile_cache
+
+    _enable_compile_cache(jax)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 

@@ -520,7 +520,7 @@ def register_lm(sub: argparse._SubParsersAction) -> None:
         help="moe swaps every block's MLP for a top-1 routed "
         "mixture-of-experts (models/moe.py) with the load-balance aux "
         "loss folded into the objective; experts are sharded over the "
-        "mesh (EP) when --num-experts divides the device count, else "
+        "mesh (EP) when the device count divides --num-experts, else "
         "replicated",
     )
     lm.add_argument("--num-experts", type=int, default=8)
@@ -536,6 +536,10 @@ def register_lm(sub: argparse._SubParsersAction) -> None:
     lm.add_argument("--resume", action="store_true")
     lm.add_argument("--experiment", default="lm")
     lm.add_argument("--tracking-root", default=None)
+    lm.add_argument(
+        "--coordinator", default=None,
+        help="host:port for multi-host rendezvous (process 0)",
+    )
     lm.set_defaults(fn=_cmd_lm)
 
 
@@ -545,7 +549,10 @@ def _cmd_lm(args: argparse.Namespace) -> int:
     from ..datagen.tokens import TokenStreamConfig, entropy_floor, token_batches
     from ..models import TransformerLM
     from ..parallel import LMTask, Trainer, TrainerConfig
-    from ..runtime import make_mesh
+    from ..runtime import initialize_distributed, local_topology, make_mesh
+
+    initialize_distributed(coordinator_address=args.coordinator)
+    topo = local_topology()
 
     stream = TokenStreamConfig(
         vocab_size=args.vocab,
@@ -604,14 +611,17 @@ def _cmd_lm(args: argparse.Namespace) -> int:
         tracker=tracker,
     )
 
-    # Eval split: a fresh sample path of the SAME chain (sample_seed only
-    # reseeds the trajectory, not the transition matrix).
+    # Per-process sample seeds: every host draws a DISJOINT trajectory of
+    # the SAME chain (the multi-host analogue of cur_shard/shard_count —
+    # without it each process would train on identical batches and the
+    # global batch would carry no extra information). Eval rides a third
+    # seed range, shared across processes.
     result = trainer.fit(
         task,
-        token_batches(stream),
+        token_batches(stream, sample_seed=args.seed + 1 + topo.process_index),
         val_data_factory=lambda: token_batches(
             stream, num_batches=args.limit_val_batches,
-            sample_seed=args.seed + 1000,
+            sample_seed=args.seed + 100_000,
         ),
     )
     if tracker is not None:
