@@ -42,17 +42,46 @@ def _to_numpy(v) -> np.ndarray:
 
 
 def load_state_dict(path: str | Path) -> dict[str, np.ndarray]:
-    """Read a torchvision-layout state dict from .pt/.pth (torch) or .npz."""
+    """Read a torchvision-layout state dict from .pt/.pth (torch) or .npz.
+
+    Lightning-style checkpoints are unwrapped twice: the ``state_dict``
+    envelope, then any uniform submodule-attribute prefix (a
+    ``LightningModule`` holding the backbone as ``self.model`` saves keys
+    like ``model.conv1.weight``) — detected from wherever
+    ``conv1.weight`` actually lives, so the attribute name doesn't
+    matter.
+    """
     path = Path(path)
     if path.suffix == ".npz":
         with np.load(path) as z:
-            return {k: z[k] for k in z.files}
+            return _strip_wrapper_prefix({k: z[k] for k in z.files})
     import torch
 
     state = torch.load(path, map_location="cpu", weights_only=True)
     if isinstance(state, Mapping) and "state_dict" in state:
         state = state["state_dict"]
-    return {k: _to_numpy(v) for k, v in state.items()}
+    return _strip_wrapper_prefix({k: _to_numpy(v) for k, v in state.items()})
+
+
+# Unlike conv1/bn1 (which recur inside blocks as layerN.M.conv1...), the
+# classifier head exists exactly once at the torchvision layout's root.
+_ANCHOR = "fc.weight"
+
+
+def _strip_wrapper_prefix(state: dict) -> dict:
+    """Strip a uniform wrapper prefix (``model.``/``module.``/anything)."""
+    if _ANCHOR in state:
+        return state
+    prefixes = {k[: -len(_ANCHOR)] for k in state if k.endswith(_ANCHOR)}
+    if len(prefixes) != 1:
+        return state  # no (or ambiguous) anchor: leave keys untouched
+    prefix = prefixes.pop()
+    if not prefix:
+        return state
+    return {
+        (k[len(prefix):] if k.startswith(prefix) else k): v
+        for k, v in state.items()
+    }
 
 
 def _torch_name(path: tuple[str, ...], stage_sizes) -> tuple[str, str]:

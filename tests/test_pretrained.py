@@ -284,3 +284,50 @@ def test_load_pretrained_resnet_npz_round_trip(tmp_path):
     np.testing.assert_array_equal(
         out["batch_stats"]["norm_init"]["mean"], state["bn1.running_mean"]
     )
+
+
+def test_load_pretrained_resnet_torch_pt_round_trip(tmp_path):
+    # The actual torch serialization path (reference weights ship as
+    # .pt/.pth): torch.save a tensor state dict, load through
+    # load_state_dict's torch.load(weights_only=True) branch.
+    torch = pytest.importorskip("torch")
+
+    state = tiny_torch_state()
+    path = tmp_path / "weights.pt"
+    torch.save({k: torch.from_numpy(np.asarray(v)) for k, v in state.items()},
+               path)
+    loaded = load_state_dict(path)
+    assert set(loaded) == set(state)
+    model = _tiny_model(torch_padding=True)
+    out = load_pretrained_resnet(path, model, image_size=32)
+    np.testing.assert_array_equal(
+        out["params"]["conv_init"]["kernel"],
+        np.transpose(state["conv1.weight"], (2, 3, 1, 0)),
+    )
+    np.testing.assert_array_equal(
+        out["batch_stats"]["norm_init"]["var"], state["bn1.running_var"]
+    )
+
+
+def test_load_pretrained_resnet_lightning_style_checkpoint(tmp_path):
+    # A REAL Lightning checkpoint of the reference's module wraps twice:
+    # {"state_dict": {...}} AND a submodule-attribute prefix on every key
+    # (the reference holds the backbone as ``self.model``, so keys are
+    # ``model.conv1.weight``...). The loader must unwrap both.
+    torch = pytest.importorskip("torch")
+
+    state = tiny_torch_state()
+    path = tmp_path / "ckpt.pth"
+    torch.save(
+        {"state_dict": {f"model.{k}": torch.from_numpy(np.asarray(v))
+                        for k, v in state.items()}},
+        path,
+    )
+    loaded = load_state_dict(path)
+    assert set(loaded) == set(state)  # prefix stripped
+    model = _tiny_model(torch_padding=True)
+    out = load_pretrained_resnet(path, model, image_size=32)
+    np.testing.assert_array_equal(
+        out["params"]["conv_init"]["kernel"],
+        np.transpose(state["conv1.weight"], (2, 3, 1, 0)),
+    )
