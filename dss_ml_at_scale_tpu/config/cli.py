@@ -18,6 +18,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = parser.add_subparsers(dest="command")
     info = sub.add_parser("info", help="show runtime topology and devices")
+    info.add_argument(
+        "--probe", type=float, default=None, metavar="SECONDS",
+        help="query devices in a watchdog subprocess with this timeout "
+        "instead of in-process — reports an unreachable accelerator "
+        "(e.g. a hung TPU tunnel, which blocks jax.devices() forever) "
+        "as a diagnostic instead of hanging",
+    )
     info.set_defaults(fn=_cmd_info)
 
     from .commands import register_all
@@ -26,7 +33,28 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
-def _cmd_info(_args: argparse.Namespace) -> int:
+def _cmd_info(args: argparse.Namespace) -> int:
+    if getattr(args, "probe", None):
+        import subprocess
+
+        try:
+            # The child is this same CLI without --probe, so both paths
+            # print identical output by construction.
+            proc = subprocess.run(
+                [sys.executable, "-m", "dss_ml_at_scale_tpu.config.cli",
+                 "info"],
+                timeout=args.probe, capture_output=True, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"accelerator unreachable: device query did not return "
+                f"within {args.probe:g}s (hung backend tunnel?)"
+            )
+            return 3
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        return proc.returncode
+
     import jax
 
     from ..runtime import local_topology

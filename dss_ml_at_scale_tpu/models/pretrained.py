@@ -57,7 +57,18 @@ def load_state_dict(path: str | Path) -> dict[str, np.ndarray]:
             return _strip_wrapper_prefix({k: z[k] for k in z.files})
     import torch
 
-    state = torch.load(path, map_location="cpu", weights_only=True)
+    try:
+        state = torch.load(path, map_location="cpu", weights_only=True)
+    except Exception:
+        # Real Lightning checkpoints carry benign non-tensor payloads
+        # (hyper_parameters as an argparse.Namespace, optimizer_states)
+        # that the strict unpickler rejects. Allowlist Namespace — still
+        # weights_only, no arbitrary code execution — and retry; anything
+        # beyond that should be re-exported as a plain state dict.
+        import argparse as _argparse
+
+        with torch.serialization.safe_globals([_argparse.Namespace]):
+            state = torch.load(path, map_location="cpu", weights_only=True)
     if isinstance(state, Mapping) and "state_dict" in state:
         state = state["state_dict"]
     return _strip_wrapper_prefix({k: _to_numpy(v) for k, v in state.items()})
@@ -76,7 +87,9 @@ def _strip_wrapper_prefix(state: dict) -> dict:
     if len(prefixes) != 1:
         return state  # no (or ambiguous) anchor: leave keys untouched
     prefix = prefixes.pop()
-    if not prefix:
+    if not prefix or not prefix.endswith("."):
+        # Either no wrapper, or the anchor match is a partial key like
+        # ``aux_fc.weight`` — stripping would mangle sibling keys.
         return state
     return {
         (k[len(prefix):] if k.startswith(prefix) else k): v

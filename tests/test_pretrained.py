@@ -331,3 +331,39 @@ def test_load_pretrained_resnet_lightning_style_checkpoint(tmp_path):
         out["params"]["conv_init"]["kernel"],
         np.transpose(state["conv1.weight"], (2, 3, 1, 0)),
     )
+
+
+def test_load_pretrained_namespace_hyperparameters(tmp_path):
+    # Genuine Lightning checkpoints include non-tensor payloads
+    # (save_hyperparameters() → argparse.Namespace) that strict
+    # weights_only unpickling rejects; the loader allowlists Namespace
+    # and retries rather than failing before the state_dict unwrap.
+    import argparse
+
+    torch = pytest.importorskip("torch")
+
+    state = tiny_torch_state()
+    path = tmp_path / "lightning_full.ckpt"
+    torch.save(
+        {
+            "state_dict": {f"model.{k}": torch.from_numpy(np.asarray(v))
+                           for k, v in state.items()},
+            "hyper_parameters": argparse.Namespace(lr=1e-5, batch_size=212),
+            "epoch": 2,
+        },
+        path,
+    )
+    loaded = load_state_dict(path)
+    assert set(loaded) == set(state)
+
+
+def test_strip_prefix_requires_module_boundary():
+    # A key merely ENDING in fc.weight (aux_fc.weight) must not cause
+    # sibling keys to be truncated.
+    from dss_ml_at_scale_tpu.models.pretrained import _strip_wrapper_prefix
+
+    state = {
+        "aux_fc.weight": np.zeros(1),
+        "aux_bn.running_mean": np.zeros(1),
+    }
+    assert _strip_wrapper_prefix(dict(state)).keys() == state.keys()
