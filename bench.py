@@ -207,6 +207,18 @@ def _emit(result: dict, notes: list[str]) -> None:
 
 
 
+def _peak_device_memory(jax):
+    """Peak bytes in use on device 0, where the backend reports it
+    (TPU/GPU plugins do; the CPU backend returns None)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            return int(stats.get("peak_bytes_in_use", 0)) or None
+    except Exception:
+        pass
+    return None
+
+
 def _enable_compile_cache(jax) -> None:
     """Persistent XLA compilation cache shared across bench runs.
 
@@ -535,6 +547,13 @@ def child_train() -> None:
 
         import tempfile
 
+        # Peak across the WHOLE sweep — including any failed/OOM'd batch
+        # attempts — hence the explicit _sweep suffix; it bounds HBM for
+        # the largest configuration tried, not the best batch alone.
+        peak = _peak_device_memory(jax)
+        if peak is not None:
+            result["peak_device_memory_bytes_sweep"] = peak
+
         with tempfile.TemporaryDirectory() as tmpdir:
             # -- profiler: top device-time categories -----------------------
             try:
@@ -651,6 +670,9 @@ def child_group() -> None:
             wall_seconds=round(wall, 1),
             skus_per_sec=round(groups_done / wall, 2),
         )
+        peak = _peak_device_memory(jax)
+        if peak is not None:
+            result["peak_device_memory_bytes"] = peak
 
         # Sequential estimate: the applyInPandas-style host path (same
         # kernels, one group per launch, ``group_apply`` inline executor)

@@ -34,7 +34,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _cmd_info(args: argparse.Namespace) -> int:
-    if getattr(args, "probe", None):
+    if getattr(args, "probe", None) is not None:
+        if args.probe <= 0:
+            print("--probe must be a positive number of seconds")
+            return 2
         import subprocess
 
         try:

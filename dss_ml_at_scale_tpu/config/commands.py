@@ -319,6 +319,12 @@ def register_train(sub: argparse._SubParsersAction) -> None:
         "when it compiles, per-image PIL fallback); the resolved backend "
         "is reported in the run summary",
     )
+    tr.add_argument(
+        "--on-decode-error", choices=["raise", "substitute"], default="raise",
+        help="substitute: a corrupt record becomes a zero image (tallied "
+        "in the run summary) instead of stopping the epoch — lets a "
+        "multi-hour run survive isolated data corruption",
+    )
     tr.add_argument("--limit-val-batches", type=int, default=5)
     tr.add_argument("--checkpoint-dir", default=None)
     tr.add_argument("--resume", action="store_true")
@@ -351,7 +357,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
     rows = table.num_records()
     spec = imagenet_transform_spec(
         crop=args.crop, backend=args.decode_backend,
-        output_dtype=args.image_dtype,
+        output_dtype=args.image_dtype, on_error=args.on_decode_error,
     )
     # Pretrained torchvision weights embed symmetric stride-2 padding in
     # their BatchNorm statistics; the model must match (models/pretrained.py).
@@ -467,6 +473,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 "val_acc": last.get("val_acc"),
                 "best_checkpoint": result.best_checkpoint_path,
                 "decode_backend": spec.backend,
+                "decode_substitutions": spec.substitutions.count,
             }
         )
     )

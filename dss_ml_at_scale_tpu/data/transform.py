@@ -28,6 +28,27 @@ class Field:
     shape: tuple[int, ...]  # per-row shape, () for scalar columns
 
 
+class SubstitutionCounter:
+    """Thread-safe tally of corrupt records zero-substituted by a spec."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def add(self, k: int = 1) -> None:
+        with self._lock:
+            self._n += k
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def __repr__(self) -> str:  # keep dataclass reprs readable
+        return f"SubstitutionCounter({self._n})"
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformSpec:
     """Transform + declared output schema.
@@ -43,6 +64,11 @@ class TransformSpec:
     # factory actually resolved to); None for hand-built specs.
     backend: str | None = None
     layout: str | None = None
+    # Records replaced by zero images under ``on_error="substitute"``
+    # (a mutable counter: the spec itself is frozen).
+    substitutions: "SubstitutionCounter" = dataclasses.field(
+        default_factory=lambda: SubstitutionCounter()
+    )
 
     def __call__(self, batch: Columnar) -> dict[str, np.ndarray]:
         out = dict(self.func(batch))
@@ -111,6 +137,7 @@ def imagenet_transform_spec(
     decode_threads: int | None = None,
     layout: str = "hwc",
     output_dtype: str = "float32",
+    on_error: str = "raise",
 ) -> TransformSpec:
     """The reference's training TransformSpec, columnar.
 
@@ -136,6 +163,13 @@ def imagenet_transform_spec(
     (``ClassifierTask`` normalizes uint8 batches inside the jitted step,
     where XLA fuses it into the first conv). Requires ``normalize=True``
     semantics downstream; ``normalize=False`` + uint8 is the same bytes.
+
+    ``on_error``: ``"raise"`` (default — a corrupt record stops the
+    epoch with the worker's exception, the reference stack's behavior)
+    or ``"substitute"`` — undecodable records become zero images so a
+    multi-hour run survives isolated corruption; substitutions are
+    tallied on ``spec.substitutions.count`` (thread-safe) for callers to
+    report.
     """
     if backend not in ("auto", "native", "pil"):
         raise ValueError(f"unknown backend {backend!r}")
@@ -143,6 +177,8 @@ def imagenet_transform_spec(
         raise ValueError(f"unknown layout {layout!r}")
     if output_dtype not in ("float32", "uint8"):
         raise ValueError(f"unknown output_dtype {output_dtype!r}")
+    if on_error not in ("raise", "substitute"):
+        raise ValueError(f"unknown on_error {on_error!r}")
     if output_dtype == "uint8" and not normalize:
         # uint8 batches are ALWAYS normalized on device by the task; a
         # normalize=False uint8 spec would silently train on different
@@ -181,6 +217,20 @@ def imagenet_transform_spec(
             )
         return img
 
+    def _count_substitution(n: int = 1) -> None:
+        spec.substitutions.add(n)
+
+    image_shape = (crop, crop, 3) if layout == "hwc" else (3, crop, crop)
+
+    def _decode_pil_or_zero(b: bytes) -> np.ndarray:
+        try:
+            return _decode_pil(b)
+        except Exception:
+            if on_error == "raise":
+                raise
+            _count_substitution()
+            return np.zeros(image_shape, np.dtype(output_dtype))
+
     def _func(batch: Columnar) -> Columnar:
         jpegs = [bytes(b) for b in batch[content_column]]
         if use_native:
@@ -195,18 +245,21 @@ def imagenet_transform_spec(
                 num_threads=decode_threads,
             )
             if not ok.all():
-                if backend == "native":
+                if backend == "native" and on_error == "raise":
                     bad = int((~ok).sum())
                     raise ValueError(f"native decode failed for {bad} images")
                 for i in np.flatnonzero(~ok):
-                    images[i] = _decode_pil(jpegs[i])
+                    if backend == "native":  # substitute, no PIL fallback
+                        _count_substitution()
+                        images[i] = 0
+                    else:
+                        images[i] = _decode_pil_or_zero(jpegs[i])
         else:
-            images = np.stack([_decode_pil(b) for b in jpegs])
+            images = np.stack([_decode_pil_or_zero(b) for b in jpegs])
         labels = np.asarray(batch[label_column], np.int32)
         return {"image": images, "label": labels}
 
-    image_shape = (crop, crop, 3) if layout == "hwc" else (3, crop, crop)
-    return TransformSpec(
+    spec = TransformSpec(
         func=_func,
         fields=[
             Field("image", np.dtype(output_dtype), image_shape),
@@ -215,3 +268,4 @@ def imagenet_transform_spec(
         backend="native" if use_native else "pil",
         layout=layout,
     )
+    return spec

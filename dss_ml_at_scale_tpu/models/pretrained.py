@@ -57,9 +57,11 @@ def load_state_dict(path: str | Path) -> dict[str, np.ndarray]:
             return _strip_wrapper_prefix({k: z[k] for k in z.files})
     import torch
 
+    import pickle
+
     try:
         state = torch.load(path, map_location="cpu", weights_only=True)
-    except Exception:
+    except pickle.UnpicklingError:
         # Real Lightning checkpoints carry benign non-tensor payloads
         # (hyper_parameters as an argparse.Namespace, optimizer_states)
         # that the strict unpickler rejects. Allowlist Namespace — still

@@ -111,3 +111,36 @@ def test_uint8_task_normalizes_on_device(devices8):
         float(m_f32["train_loss"]), rel=1e-5
     )
     assert jnp.isfinite(m_u8["train_loss"])
+
+
+def test_on_error_substitute_survives_corrupt_records():
+    # A corrupt record under on_error="substitute" becomes a zero image
+    # and is counted; the good record still decodes. Both decode
+    # backends (whichever "auto" resolves to here, plus forced PIL).
+    for backend in ("auto", "pil"):
+        spec = imagenet_transform_spec(
+            crop=64, resize=64, backend=backend, on_error="substitute"
+        )
+        batch = {
+            "content": np.array(
+                [_jpeg(80, 70), b"not a jpeg at all"], dtype=object
+            ),
+            "label_index": np.array([3, 4]),
+        }
+        out = spec(batch)
+        assert out["image"].shape == (2, 64, 64, 3)
+        assert np.abs(out["image"][0]).sum() > 0  # good record decoded
+        assert np.all(out["image"][1] == 0)  # corrupt -> zero image
+        assert spec.substitutions.count == 1, (backend, spec.substitutions)
+
+
+def test_on_error_raise_is_default():
+    spec = imagenet_transform_spec(crop=64, resize=64)
+    batch = {
+        "content": np.array([b"junk"], dtype=object),
+        "label_index": np.array([0]),
+    }
+    with pytest.raises(Exception):
+        spec(batch)
+    with pytest.raises(ValueError, match="on_error"):
+        imagenet_transform_spec(on_error="skip")
