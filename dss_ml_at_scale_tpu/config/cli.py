@@ -19,7 +19,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub = parser.add_subparsers(dest="command")
     info = sub.add_parser("info", help="show runtime topology and devices")
     info.add_argument(
-        "--probe", type=float, default=None, metavar="SECONDS",
+        "--probe", type=_positive_seconds, default=None, metavar="SECONDS",
         help="query devices in a watchdog subprocess with this timeout "
         "instead of in-process — reports an unreachable accelerator "
         "(e.g. a hung TPU tunnel, which blocks jax.devices() forever) "
@@ -33,11 +33,17 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _positive_seconds(s: str) -> float:
+    v = float(s)
+    if v <= 0:
+        raise argparse.ArgumentTypeError(
+            f"must be a positive number of seconds, got {s!r}"
+        )
+    return v
+
+
 def _cmd_info(args: argparse.Namespace) -> int:
     if getattr(args, "probe", None) is not None:
-        if args.probe <= 0:
-            print("--probe must be a positive number of seconds")
-            return 2
         import subprocess
 
         try:

@@ -25,14 +25,21 @@ def prefetch_to_mesh(
     *,
     axis: str = "data",
     depth: int = 2,
+    specs=None,
 ) -> Iterator:
-    """Yield batches placed on ``mesh`` (batch-sharded), ``depth`` ahead."""
+    """Yield batches placed on ``mesh`` (batch-sharded), ``depth`` ahead.
+
+    ``specs``: per-key ``PartitionSpec`` overrides (see
+    :func:`~dss_ml_at_scale_tpu.runtime.mesh.shard_batch_to_mesh`) — how
+    sequence-parallel batches shard the sequence dim instead of the batch
+    dim.
+    """
     if depth < 1:
         raise ValueError("depth must be >= 1")
     buf = collections.deque()
     it = iter(it)
     for batch in it:
-        buf.append(shard_batch_to_mesh(batch, mesh, axis=axis))
+        buf.append(shard_batch_to_mesh(batch, mesh, axis=axis, specs=specs))
         if len(buf) >= depth:
             yield buf.popleft()
     while buf:

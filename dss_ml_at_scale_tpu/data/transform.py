@@ -67,7 +67,7 @@ class TransformSpec:
     # Records replaced by zero images under ``on_error="substitute"``
     # (a mutable counter: the spec itself is frozen).
     substitutions: "SubstitutionCounter" = dataclasses.field(
-        default_factory=lambda: SubstitutionCounter()
+        default_factory=SubstitutionCounter
     )
 
     def __call__(self, batch: Columnar) -> dict[str, np.ndarray]:
@@ -166,10 +166,11 @@ def imagenet_transform_spec(
 
     ``on_error``: ``"raise"`` (default — a corrupt record stops the
     epoch with the worker's exception, the reference stack's behavior)
-    or ``"substitute"`` — undecodable records become zero images so a
-    multi-hour run survives isolated corruption; substitutions are
-    tallied on ``spec.substitutions.count`` (thread-safe) for callers to
-    report.
+    or ``"substitute"`` — undecodable records become dataset-MEAN images
+    (zeros in post-normalization space; the same training input under
+    every dtype/normalize configuration) so a multi-hour run survives
+    isolated corruption; substitutions are tallied on
+    ``spec.substitutions.count`` (thread-safe) for callers to report.
     """
     if backend not in ("auto", "native", "pil"):
         raise ValueError(f"unknown backend {backend!r}")
@@ -221,15 +222,33 @@ def imagenet_transform_spec(
         spec.substitutions.add(n)
 
     image_shape = (crop, crop, 3) if layout == "hwc" else (3, crop, crop)
+    stats_shape = (1, 1, 3) if layout == "hwc" else (3, 1, 1)
 
-    def _decode_pil_or_zero(b: bytes) -> np.ndarray:
+    def _substitute_image() -> np.ndarray:
+        """The dataset-MEAN image in this spec's output value space, so a
+        substituted record is the same training input under every
+        (output_dtype, normalize) configuration: zeros post-normalize ==
+        IMAGENET_MEAN raw == round(255·mean) uint8 (which the device-side
+        normalization maps back to ~0)."""
+        if output_dtype == "uint8":
+            img = np.round(IMAGENET_MEAN * 255.0).astype(np.uint8)
+            return np.broadcast_to(
+                img.reshape(stats_shape), image_shape
+            ).copy()
+        if normalize:
+            return np.zeros(image_shape, np.float32)
+        return np.broadcast_to(
+            IMAGENET_MEAN.reshape(stats_shape).astype(np.float32), image_shape
+        ).copy()
+
+    def _decode_pil_or_substitute(b: bytes) -> np.ndarray:
         try:
             return _decode_pil(b)
         except Exception:
             if on_error == "raise":
                 raise
             _count_substitution()
-            return np.zeros(image_shape, np.dtype(output_dtype))
+            return _substitute_image()
 
     def _func(batch: Columnar) -> Columnar:
         jpegs = [bytes(b) for b in batch[content_column]]
@@ -251,11 +270,11 @@ def imagenet_transform_spec(
                 for i in np.flatnonzero(~ok):
                     if backend == "native":  # substitute, no PIL fallback
                         _count_substitution()
-                        images[i] = 0
+                        images[i] = _substitute_image()
                     else:
-                        images[i] = _decode_pil_or_zero(jpegs[i])
+                        images[i] = _decode_pil_or_substitute(jpegs[i])
         else:
-            images = np.stack([_decode_pil_or_zero(b) for b in jpegs])
+            images = np.stack([_decode_pil_or_substitute(b) for b in jpegs])
         labels = np.asarray(batch[label_column], np.int32)
         return {"image": images, "label": labels}
 

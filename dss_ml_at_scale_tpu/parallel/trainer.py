@@ -284,6 +284,11 @@ class TrainerConfig:
     # (DDP replicates optimizer state on every rank).
     shard_opt_state: bool = False
     shard_axis: str = "data"
+    # Per-key PartitionSpec overrides for batch placement (default: shard
+    # the leading dim over "data"). Sequence-parallel LM training passes
+    # {"tokens": P(None, "sp")} so batches shard the sequence dimension
+    # and ring attention sees its expected layout.
+    batch_specs: Mapping[str, Any] | None = None
 
 
 @dataclasses.dataclass
@@ -385,7 +390,7 @@ class Trainer:
             yield from train_iter
 
         device_batches = prefetch_to_mesh(
-            batches(), mesh, depth=cfg.prefetch_depth
+            batches(), mesh, depth=cfg.prefetch_depth, specs=cfg.batch_specs
         )
 
         history: list[dict] = []
@@ -508,7 +513,8 @@ class Trainer:
             if cfg.limit_val_batches is not None:
                 source = itertools.islice(source, cfg.limit_val_batches)
             val_batches = prefetch_to_mesh(
-                source, self.mesh, depth=cfg.prefetch_depth
+                source, self.mesh, depth=cfg.prefetch_depth,
+                specs=cfg.batch_specs,
             )
             for batch in val_batches:
                 m = eval_step(state, batch)

@@ -94,14 +94,30 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch_to_mesh(batch, mesh: Mesh, axis: str = "data"):
+def shard_batch_to_mesh(batch, mesh: Mesh, axis: str = "data", specs=None):
     """Place a host-global pytree of arrays onto the mesh, batch-sharded.
 
     In a multi-process run each process passes its *local* shard and JAX
     assembles the global array (``jax.make_array_from_process_local_data``);
     single-process, this is a plain sharded device_put. Scalar (0-d)
     leaves have no batch dim and are replicated.
+
+    ``specs`` (optional, Mapping key → ``PartitionSpec``) overrides the
+    default leading-dim sharding for named top-level keys — e.g.
+    ``{"tokens": P(None, "sp")}`` shards the sequence dimension for
+    sequence-parallel training. ``batch`` must be a Mapping when
+    ``specs`` is given.
     """
+    def _place_spec(x, spec):
+        sharding = NamedSharding(mesh, spec)
+        if jax.process_count() > 1:
+            # Same contract as the default path: each process passes its
+            # LOCAL shard and JAX assembles the global array.
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            )
+        return jax.device_put(x, sharding)
+
     def _place(x):
         if np.ndim(x) == 0:
             return jax.device_put(x, NamedSharding(mesh, P()))
@@ -120,5 +136,21 @@ def shard_batch_to_mesh(batch, mesh: Mesh, axis: str = "data"):
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(sharding, np.asarray(x))
         return jax.device_put(x, sharding)
+
+    if specs:
+        if not isinstance(batch, Mapping):
+            raise TypeError("shard_batch_to_mesh(specs=...) needs a Mapping batch")
+        unknown = set(specs) - set(batch)
+        if unknown:
+            # A misspelled key silently falling back to batch sharding
+            # would produce wrong layouts (and wrong math) with no error.
+            raise KeyError(
+                f"specs keys not in batch: {sorted(unknown)}; "
+                f"batch has {sorted(batch)}"
+            )
+        return {
+            k: (_place_spec(v, specs[k]) if k in specs else _place(v))
+            for k, v in batch.items()
+        }
 
     return jax.tree_util.tree_map(_place, batch)

@@ -139,3 +139,56 @@ def test_transformer_ring_sequence_parallel_train_step(rng, seq_mesh):
     loss_ref = next_token_loss(m_ref.apply(params, tokens), tokens)
     loss_ring = next_token_loss(model.apply(params, tokens), tokens)
     np.testing.assert_allclose(float(loss_ring), float(loss_ref), atol=1e-4)
+
+
+def test_lm_sp_trains_under_trainer(devices8, seq_mesh):
+    # The claim in LMTask's docstring, proven: sequence-parallel ring
+    # attention rides the IDENTICAL Trainer machinery — batches shard the
+    # sequence dim via TrainerConfig.batch_specs and the loss falls
+    # toward the Markov source's entropy floor.
+    from dss_ml_at_scale_tpu.datagen.tokens import (
+        TokenStreamConfig,
+        entropy_floor,
+        token_batches,
+    )
+    from dss_ml_at_scale_tpu.parallel import LMTask, Trainer, TrainerConfig
+
+    stream = TokenStreamConfig(
+        vocab_size=16, batch_size=4, seq_len=64, concentration=0.05, seed=0
+    )
+    lm = TransformerLM(
+        vocab_size=16, dim=32, num_heads=2, num_layers=1, max_seq=64,
+        dtype=jnp.float32, attention="ring", mesh=seq_mesh, axis_name="sp",
+    )
+    task = LMTask(model=lm, tx=optax.adam(1e-2))
+    trainer = Trainer(
+        TrainerConfig(
+            max_epochs=2,
+            steps_per_epoch=40,
+            limit_val_batches=2,
+            log_every_steps=1000,
+            batch_specs={"tokens": P(None, "sp")},
+        ),
+        mesh=seq_mesh,
+    )
+    result = trainer.fit(
+        task,
+        token_batches(stream),
+        val_data_factory=lambda: token_batches(
+            stream, num_batches=2, sample_seed=999
+        ),
+    )
+    assert len(result.history) == 2
+    floor = entropy_floor(stream)
+    # Training moved val loss decisively below uniform toward the floor.
+    assert result.history[-1]["val_loss"] < 0.7 * np.log(16)
+    assert result.history[-1]["val_loss"] > floor - 0.05
+    # The batch really was sequence-sharded (not replicated): check via a
+    # fresh placement through the same path.
+    from dss_ml_at_scale_tpu.runtime.mesh import shard_batch_to_mesh
+
+    placed = shard_batch_to_mesh(
+        next(token_batches(stream, num_batches=1)), seq_mesh,
+        specs={"tokens": P(None, "sp")},
+    )
+    assert not placed["tokens"].sharding.is_fully_replicated

@@ -144,3 +144,45 @@ def test_on_error_raise_is_default():
         spec(batch)
     with pytest.raises(ValueError, match="on_error"):
         imagenet_transform_spec(on_error="skip")
+
+
+def test_substitute_is_mean_image_in_every_value_space():
+    # A substituted record must be the SAME training input regardless of
+    # (output_dtype, normalize): zeros post-normalize, the dataset mean
+    # raw, round(255*mean) uint8.
+    from dss_ml_at_scale_tpu.data.transform import IMAGENET_MEAN
+
+    batch = {
+        "content": np.array([b"junk"], dtype=object),
+        "label_index": np.array([0]),
+    }
+    f_norm = imagenet_transform_spec(
+        crop=8, resize=8, backend="pil", on_error="substitute"
+    )(batch)["image"][0]
+    assert np.all(f_norm == 0)
+    f_raw = imagenet_transform_spec(
+        crop=8, resize=8, backend="pil", normalize=False,
+        on_error="substitute",
+    )(batch)["image"][0]
+    np.testing.assert_allclose(f_raw[0, 0], IMAGENET_MEAN, atol=1e-6)
+    u8 = imagenet_transform_spec(
+        crop=8, resize=8, backend="pil", output_dtype="uint8",
+        on_error="substitute",
+    )(batch)["image"][0]
+    np.testing.assert_array_equal(
+        u8[0, 0], np.round(IMAGENET_MEAN * 255).astype(np.uint8)
+    )
+
+
+def test_shard_batch_specs_rejects_unknown_keys(devices8):
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from dss_ml_at_scale_tpu.runtime.mesh import shard_batch_to_mesh
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    with pytest.raises(KeyError, match="token"):
+        shard_batch_to_mesh(
+            {"tokens": np.zeros((2, 8), np.int32)}, mesh,
+            specs={"token": P(None, "data")},
+        )
