@@ -496,7 +496,10 @@ def child_train() -> None:
         # Reference per-rank batch is 212 (deep_learning/2...py:342); the
         # sweep adds larger TPU-shaped candidates (bf16 ResNet-50 fits
         # them all on a v5e chip).
-        batches = [212, 256, 384, 512] if on_accel else [8]
+        # 212 is the reference's per-rank batch (2...py:342); larger
+        # TPU-shaped candidates follow. 768 probes the HBM ceiling — an
+        # OOM there is caught as a sweep point, not a failure.
+        batches = [212, 256, 384, 512, 768] if on_accel else [8]
         image = 224 if on_accel else 64
         steps = 10 if on_accel else 2
         peak_flops = PEAK_BF16_FLOPS.get(device_kind)
