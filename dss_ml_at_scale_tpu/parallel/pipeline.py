@@ -45,6 +45,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+__all__ = [
+    "PipelinedTask",
+    "pipeline_utilization",
+    "spmd_pipeline",
+    "stack_stage_params",
+    "stage_sharding",
+]
+
 from ._compat import shard_map_unchecked
 
 
@@ -158,3 +166,113 @@ def spmd_pipeline(
 def pipeline_utilization(n_micro: int, n_stages: int) -> float:
     """GPipe bubble accounting: fraction of ticks doing useful work."""
     return n_micro / (n_micro + n_stages - 1)
+
+
+class PipelinedTask:
+    """Pipeline-parallel regression task for the standard Trainer loop.
+
+    The PP analogue of ``LMTask``/``ClassifierTask``: stage parameters
+    are stacked and STAGE-SHARDED over ``axis_name`` (declared via the
+    ``state_shardings`` hook the Trainer honors — PP params are the one
+    task family that must not be replicated), and every train step runs
+    the GPipe microbatch schedule end-to-end with the optimizer update.
+
+    Batches: ``{"x": [n_micro, micro_batch, d], "y": like x}``; loss is
+    MSE of the pipeline output against ``y``. With a ``batch_axis``, pass
+    ``TrainerConfig(batch_specs={"x": P(None, axis), "y": P(None, axis)})``
+    so batch placement matches the pipeline's PP × DP layout.
+    """
+
+    def __init__(self, stage_fn, init_stage_fn, mesh: Mesh,
+                 axis_name: str = "pipe", batch_axis: str | None = None,
+                 tx=None, learning_rate: float = 1e-2):
+        import optax
+
+        self.stage_fn = stage_fn
+        self.init_stage_fn = init_stage_fn
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.n_stages = mesh.shape[axis_name]
+        self.tx = tx if tx is not None else optax.adam(learning_rate)
+        self.run = spmd_pipeline(stage_fn, mesh, axis_name, batch_axis)
+
+    # Lower is better for the Trainer's best-checkpoint tracking.
+    default_best_metric = "val_loss"
+    default_best_mode = "min"
+
+    def batch_size_of(self, batch) -> int:
+        """Examples per batch = n_micro × micro_batch (Trainer hook)."""
+        x = batch["x"]
+        return int(x.shape[0]) * int(x.shape[1])
+
+    def init_state(self, rng, sample_batch):
+        from .trainer import TrainState
+
+        params = stack_stage_params(self.init_stage_fn, rng, self.n_stages)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats={},
+            opt_state=self.tx.init(params),
+        )
+
+    def state_shardings(self, state, mesh: Mesh):
+        """Stage-shard params AND the mirrored optimizer moments; scalars
+        (step, optax counters) replicate."""
+        if mesh is not self.mesh and dict(mesh.shape) != dict(self.mesh.shape):
+            # The schedule (self.run) was built against self.mesh; a
+            # Trainer running a different mesh would place state on one
+            # mesh and execute shard_map over another.
+            raise ValueError(
+                f"Trainer mesh {dict(mesh.shape)} != PipelinedTask mesh "
+                f"{dict(self.mesh.shape)}; construct the task with the "
+                "Trainer's mesh"
+            )
+        stage = stage_sharding(state.params, mesh, self.axis_name)
+        replicated = NamedSharding(mesh, P())
+
+        def moments(tree):
+            # optax state leaves either mirror the stacked param shapes
+            # (Adam m/v) or are scalars/counters.
+            def leaf(l):
+                ndim = getattr(l, "ndim", 0)
+                shape = getattr(l, "shape", ())
+                if ndim >= 1 and shape[0] == self.n_stages:
+                    return NamedSharding(
+                        mesh, P(self.axis_name, *([None] * (ndim - 1)))
+                    )
+                return replicated
+            return jax.tree_util.tree_map(leaf, tree)
+
+        return type(state)(
+            step=replicated,
+            params=stage,
+            batch_stats=jax.tree_util.tree_map(lambda _: replicated,
+                                               state.batch_stats),
+            opt_state=moments(state.opt_state),
+        )
+
+    def train_step(self, state, batch):
+        import optax
+
+        xs, ys = batch["x"], batch["y"]
+
+        def loss_fn(params):
+            return jnp.mean((self.run(params, xs) - ys) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            type(state)(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=state.batch_stats,
+                opt_state=new_opt,
+            ),
+            {"train_loss": loss},
+        )
+
+    def eval_step(self, state, batch):
+        loss = jnp.mean((self.run(state.params, batch["x"]) - batch["y"]) ** 2)
+        return {"val_loss": loss}

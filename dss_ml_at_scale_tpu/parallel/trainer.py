@@ -358,17 +358,45 @@ class Trainer:
 
         train_iter = iter(train_data)
         first = next(train_iter)
-        per_process_batch = len(next(iter(first.values())))
+        # Examples per batch: the leading dim by default; tasks whose
+        # batches aren't [batch, ...] (PipelinedTask: [n_micro, mb, ...])
+        # declare a ``batch_size_of`` hook so steps/epoch and throughput
+        # accounting stay correct.
+        size_hook = getattr(task, "batch_size_of", None)
+        per_process_batch = (
+            size_hook(first) if size_hook is not None
+            else len(next(iter(first.values())))
+        )
         steps_per_epoch = self._steps_per_epoch(per_process_batch)
 
         replicated = NamedSharding(mesh, P())
         if state is None:
             state = task.init_state(rng, first)
-        state_shardings = jax.tree_util.tree_map(lambda _: replicated, state)
-        if cfg.shard_opt_state:
-            state_shardings = state_shardings.replace(
-                opt_state=_zero1_shardings(state.opt_state, mesh, cfg.shard_axis)
+        # Tasks whose parameters are NOT replicated (pipeline stages live
+        # on their own devices; a fully tensor-sharded model would too)
+        # declare their layout via a ``state_shardings(state, mesh)``
+        # hook; everything else defaults to replicated params.
+        shardings_hook = getattr(task, "state_shardings", None)
+        if shardings_hook is not None:
+            if cfg.shard_opt_state:
+                # ZeRO-1 would overwrite the task's own optimizer layout
+                # (e.g. stage-sharded Adam moments) — conflicting intents.
+                raise ValueError(
+                    "shard_opt_state=True conflicts with a task that "
+                    "declares its own state_shardings; the task's layout "
+                    "already places the optimizer state"
+                )
+            state_shardings = shardings_hook(state, mesh)
+        else:
+            state_shardings = jax.tree_util.tree_map(
+                lambda _: replicated, state
             )
+            if cfg.shard_opt_state:
+                state_shardings = state_shardings.replace(
+                    opt_state=_zero1_shardings(
+                        state.opt_state, mesh, cfg.shard_axis
+                    )
+                )
         state = jax.device_put(state, state_shardings)
 
         train_step = jax.jit(task.train_step, donate_argnums=0,

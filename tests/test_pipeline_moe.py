@@ -287,3 +287,52 @@ def test_moe_transformer_trains_with_aux_loss(rng, expert_mesh):
         assert np.isfinite(float(loss)) and np.isfinite(float(aux))
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_pipelined_task_trains_under_trainer(rng, pipe_mesh):
+    # PP rides the same Trainer machinery as DP/SP/EP: stage-sharded
+    # params declared via the state_shardings hook, GPipe schedule inside
+    # the jitted step, loss falls, and the fitted params really are
+    # stage-sharded (not replicated).
+    import optax
+
+    from dss_ml_at_scale_tpu.parallel import PipelinedTask, Trainer, TrainerConfig
+
+    task = PipelinedTask(
+        _mlp_stage, _init_stage, pipe_mesh, "pipe", batch_axis="data",
+        tx=optax.adam(3e-2),
+    )
+
+    def batches(seed, n):
+        # One fixed batch repeated (like test_pipeline_trains): the test
+        # is about the machinery, not generalization.
+        r = np.random.default_rng(seed)
+        xs = r.normal(size=(8, 4, 16)).astype(np.float32)
+        for _ in range(n):
+            yield {"x": xs, "y": np.sin(xs)}
+
+    trainer = Trainer(
+        TrainerConfig(
+            max_epochs=2,
+            steps_per_epoch=40,
+            limit_val_batches=2,
+            log_every_steps=1000,
+            batch_specs={
+                "x": P(None, "data"),
+                "y": P(None, "data"),
+            },
+        ),
+        mesh=pipe_mesh,
+    )
+    result = trainer.fit(
+        task, batches(0, 80), val_data_factory=lambda: batches(99, 2)
+    )
+    assert len(result.history) == 2
+    assert result.history[1]["train_loss"] < 0.6 * result.history[0]["train_loss"]
+    # Eval ran through the same sharded path and produced a finite score
+    # (train memorizes one batch, so val MAGNITUDE is uninformative).
+    assert np.isfinite(result.history[1]["val_loss"])
+    # Params are stage-sharded over "pipe", not replicated.
+    leaf = jax.tree_util.tree_leaves(result.state.params)[0]
+    assert not leaf.sharding.is_fully_replicated
+    assert "pipe" in (leaf.sharding.spec[0] or ())
