@@ -320,6 +320,12 @@ def register_train(sub: argparse._SubParsersAction) -> None:
         "is reported in the run summary",
     )
     tr.add_argument(
+        "--fast-decode", action="store_true",
+        help="DCT-domain scaled decode for large sources (PIL draft-mode "
+        "equivalent; native backend only): ~2x decode throughput at "
+        "2048px sources, pixel values slightly off full-decode parity",
+    )
+    tr.add_argument(
         "--on-decode-error", choices=["raise", "substitute"], default="raise",
         help="substitute: a corrupt record becomes a zero image (tallied "
         "in the run summary) instead of stopping the epoch — lets a "
@@ -358,6 +364,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
     spec = imagenet_transform_spec(
         crop=args.crop, backend=args.decode_backend,
         output_dtype=args.image_dtype, on_error=args.on_decode_error,
+        fast_decode=args.fast_decode,
     )
     # Pretrained torchvision weights embed symmetric stride-2 padding in
     # their BatchNorm statistics; the model must match (models/pretrained.py).

@@ -138,6 +138,7 @@ def imagenet_transform_spec(
     layout: str = "hwc",
     output_dtype: str = "float32",
     on_error: str = "raise",
+    fast_decode: bool = False,
 ) -> TransformSpec:
     """The reference's training TransformSpec, columnar.
 
@@ -163,6 +164,12 @@ def imagenet_transform_spec(
     (``ClassifierTask`` normalizes uint8 batches inside the jitted step,
     where XLA fuses it into the first conv). Requires ``normalize=True``
     semantics downstream; ``normalize=False`` + uint8 is the same bytes.
+
+    ``fast_decode=True`` (native backend only; the PIL path ignores it)
+    decodes large sources at a DCT-domain m/8 scale covering ``resize``
+    — the PIL draft-mode trick — trading exact full-decode pixel parity
+    for substantially less IDCT work (measured ~1.7x at 1024px sources,
+    ~2.1x at 2048px; neutral at ImageNet's ~500px).
 
     ``on_error``: ``"raise"`` (default — a corrupt record stops the
     epoch with the worker's exception, the reference stack's behavior)
@@ -261,6 +268,7 @@ def imagenet_transform_spec(
                 std=IMAGENET_STD if normalize and output_dtype == "float32" else None,
                 chw=layout == "chw",
                 dtype=output_dtype,
+                fast_scale=fast_decode,
                 num_threads=decode_threads,
             )
             if not ok.all():

@@ -24,7 +24,7 @@ import numpy as np
 _SRC = Path(__file__).with_name("image_pipeline.cpp")
 _LIB = Path(__file__).with_name("libdsst_image.so")
 _HASH = Path(__file__).with_name("libdsst_image.srchash")
-_ABI = 2
+_ABI = 3
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -105,6 +105,7 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_float),
                 ctypes.c_int,
                 ctypes.c_int,
+                ctypes.c_int,
                 ctypes.c_void_p,
                 ctypes.c_int,
                 ctypes.POINTER(ctypes.c_int),
@@ -135,6 +136,7 @@ def decode_jpeg_batch(
     std: np.ndarray | None = None,
     chw: bool = True,
     dtype: str = "float32",
+    fast_scale: bool = False,
     num_threads: int | None = None,  # default: one pool of cpu_count threads;
     # callers running several decode batches concurrently should divide the
     # host's cores among themselves to avoid oversubscription
@@ -150,6 +152,11 @@ def decode_jpeg_batch(
     ``dtype="uint8"``: the raw quantized [0, 255] bytes, 4x less memory
     per image; normalization then belongs to the device program
     (``mean``/``std`` must be None).
+
+    ``fast_scale=True`` decodes big sources directly at the largest
+    DCT-domain m/8 scale covering ``resize`` (PIL draft-mode equivalent):
+    much less IDCT work per image, pixel values slightly different from
+    the full-decode path (the antialiased resize still runs).
     """
     lib = _load()
     if lib is None:
@@ -185,6 +192,7 @@ def decode_jpeg_batch(
         std_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         int(chw),
         int(out_u8),
+        int(fast_scale),
         out.ctypes.data_as(ctypes.c_void_p),
         int(num_threads),
         statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),

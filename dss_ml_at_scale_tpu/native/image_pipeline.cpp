@@ -45,8 +45,14 @@ void jpeg_silent_msg(j_common_ptr) {}
 
 // ---------------------------------------------------------------- decode --
 // Decode JPEG bytes to RGB8. Returns false on any codec error.
+// min_side_target > 0 enables DCT-domain scaling (PIL draft-mode
+// equivalent): decode directly at the largest m/8 scale whose shorter
+// side still covers the target, skipping most IDCT + colorspace work for
+// large sources. The antialiased resize then runs on the scaled output,
+// so the final tensor differs slightly from the full-decode path.
 bool decode_rgb(const unsigned char* data, unsigned long size,
-                std::vector<uint8_t>* out, int* w, int* h) {
+                std::vector<uint8_t>* out, int* w, int* h,
+                int min_side_target) {
   jpeg_decompress_struct cinfo;
   JpegErr err;
   cinfo.err = jpeg_std_error(&err.mgr);
@@ -70,6 +76,28 @@ bool decode_rgb(const unsigned char* data, unsigned long size,
     return false;
   }
   cinfo.out_color_space = JCS_RGB;
+  // Hostile-input cap must bind on the SOURCE dims: DCT scaling shrinks
+  // output_width/height, but entropy-decoding a multi-gigapixel stream
+  // still burns its full cost — reject before start_decompress either way.
+  if (static_cast<long long>(cinfo.image_width) * cinfo.image_height >
+      (512LL << 20)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  if (min_side_target > 0) {
+    unsigned int min_side = std::min(cinfo.image_width, cinfo.image_height);
+    if (min_side > static_cast<unsigned int>(min_side_target)) {
+      // Smallest m in [1, 8] with ceil(min_side * m / 8) >= target.
+      unsigned int m = 8;
+      while (m > 1 &&
+             (static_cast<unsigned long>(min_side) * (m - 1) + 7) / 8 >=
+                 static_cast<unsigned long>(min_side_target)) {
+        --m;
+      }
+      cinfo.scale_num = m;
+      cinfo.scale_denom = 8;
+    }
+  }
   jpeg_start_decompress(&cinfo);
   *w = cinfo.output_width;
   *h = cinfo.output_height;
@@ -188,10 +216,11 @@ int round_half_even(double v) { return static_cast<int>(std::nearbyint(v)); }
 // two output pointers is non-null. CHW or HWC, crop×crop.
 bool process_one(const unsigned char* jpeg, unsigned long size, int resize_to,
                  int crop, bool do_norm, const float* mean, const float* stdv,
-                 bool chw, float* outf, uint8_t* out8) {
+                 bool chw, bool fast_scale, float* outf, uint8_t* out8) {
   std::vector<uint8_t> rgb;
   int w = 0, h = 0;
-  if (!decode_rgb(jpeg, size, &rgb, &w, &h)) return false;
+  if (!decode_rgb(jpeg, size, &rgb, &w, &h, fast_scale ? resize_to : 0))
+    return false;
   double scale = static_cast<double>(resize_to) / std::min(w, h);
   int ow = std::max(1, round_half_even(w * scale));
   int oh = std::max(1, round_half_even(h * scale));
@@ -241,7 +270,8 @@ extern "C" {
 int dsst_decode_batch(const unsigned char* const* jpegs,
                       const unsigned long* sizes, int n, int resize_to,
                       int crop, int do_norm, const float* mean,
-                      const float* stdv, int chw, int out_u8, void* out,
+                      const float* stdv, int chw, int out_u8,
+                      int fast_scale, void* out,
                       int n_threads, int* statuses) {
   if (n <= 0) return 0;
   if (out_u8 && do_norm) {
@@ -264,7 +294,7 @@ int dsst_decode_batch(const unsigned char* const* jpegs,
                             ? static_cast<uint8_t*>(out) + per_image * i
                             : nullptr;
         ok = process_one(jpegs[i], sizes[i], resize_to, crop, do_norm != 0,
-                         mean, stdv, chw != 0, outf, out8);
+                         mean, stdv, chw != 0, fast_scale != 0, outf, out8);
       } catch (...) {
         // Per-image failure contract: an escaped exception (e.g. bad_alloc
         // on a pathological image) must flag the row, not terminate().
@@ -287,6 +317,6 @@ int dsst_decode_batch(const unsigned char* const* jpegs,
 }
 
 // Tiny ABI check so the Python binding can verify it loaded the right .so.
-int dsst_abi_version() { return 2; }
+int dsst_abi_version() { return 3; }
 
 }  // extern "C"

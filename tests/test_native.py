@@ -90,3 +90,33 @@ def test_auto_backend_falls_back_per_image(rng):
     out = imagenet_transform_spec(backend="auto")(batch)
     ref = imagenet_transform_spec(backend="pil")(batch)
     assert np.mean(np.abs(out["image"][1] - ref["image"][1])) < 0.05
+
+
+def test_fast_scale_decodes_close_to_full(rng):
+    # DCT-scaled decode (PIL draft equivalent) is a different pixel path;
+    # it must stay visually equivalent (small mean abs diff) and shape-
+    # identical, with small sources (min side <= resize) untouched.
+    big = _jpeg(rng, 1024, 768)
+    full, ok1 = native.decode_jpeg_batch([big], chw=False)
+    fast, ok2 = native.decode_jpeg_batch([big], chw=False, fast_scale=True)
+    assert ok1.all() and ok2.all()
+    assert full.shape == fast.shape
+    assert np.mean(np.abs(full - fast)) < 0.03  # [0,1] scale
+
+    small = _jpeg(rng, 240, 230)  # min side < resize: no DCT scaling
+    a, _ = native.decode_jpeg_batch([small], chw=False)
+    b, _ = native.decode_jpeg_batch([small], chw=False, fast_scale=True)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_transform_spec_fast_decode(rng):
+    jpegs = [_jpeg(rng, 800, 600) for _ in range(2)]
+    batch = {
+        "content": np.array(jpegs, dtype=object),
+        "label_index": np.array([0, 1]),
+    }
+    out = imagenet_transform_spec(backend="native", fast_decode=True)(batch)
+    ref = imagenet_transform_spec(backend="native")(batch)
+    assert out["image"].shape == ref["image"].shape
+    # Normalized space: tolerate the draft-mode deviation, reject garbage.
+    assert np.mean(np.abs(out["image"] - ref["image"])) < 0.15
