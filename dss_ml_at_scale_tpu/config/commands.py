@@ -207,6 +207,11 @@ def register_eda(sub: argparse._SubParsersAction) -> None:
         help="refine the single-SKU SARIMAX fits with the host-side "
         "float64 polish (closes the f32 unit-root corner)",
     )
+    eda.add_argument(
+        "--plot", default=None, metavar="PATH",
+        help="write the reference-style comparison figure (actual series "
+        "+ top models' holdout predictions) to this PNG",
+    )
     eda.set_defaults(fn=_cmd_eda)
 
 
@@ -226,11 +231,15 @@ def _cmd_eda(args: argparse.Namespace) -> int:
         parallelism=args.parallelism,
         cfg=SarimaxConfig(k_exog=len(EXO_FIELDS), max_iter=args.max_iter),
         polish=args.polish,
+        return_curves=args.plot is not None,
     )
     print(f"EDA for Product={report.product} SKU={report.sku} "
           f"(holdout {args.horizon} weeks)")
     print(report.scores.to_string(index=False))
     print(f"best SARIMAX order: {report.best_order} (mse {report.best_order_mse:.2f})")
+    if args.plot:
+        report.plot(args.plot)
+        print(f"comparison figure -> {args.plot}")
     return 0
 
 

@@ -50,12 +50,52 @@ class EdaReport:
     scores: pd.DataFrame          # columns: model, mse
     best_order: tuple[int, int, int]
     best_order_mse: float
+    # Long-format holdout predictions (Date, model, prediction) when
+    # run_eda(return_curves=True) — the data behind the reference
+    # notebook's comparison plots (group_apply/02...py:190-204,234-245).
+    curves: pd.DataFrame | None = None
+    # The SKU's actual series (Date, Demand) for plotting context.
+    series: pd.DataFrame | None = None
 
     def to_frame(self) -> pd.DataFrame:
         out = self.scores.copy()
         out.insert(0, "SKU", self.sku)
         out.insert(0, "Product", self.product)
         return out
+
+    def plot(self, path: str, top_k: int = 3) -> None:
+        """Write the reference-style comparison figure: the actual series
+        with the ``top_k`` best models' holdout predictions overlaid."""
+        if self.curves is None or self.series is None:
+            raise ValueError("plot needs run_eda(..., return_curves=True)")
+        # Object-oriented figure + Agg canvas: no pyplot, so a caller's
+        # interactive backend (notebook inline, TkAgg) is never touched.
+        from matplotlib.backends.backend_agg import FigureCanvasAgg
+        from matplotlib.figure import Figure
+
+        fig = Figure(figsize=(11, 5))
+        FigureCanvasAgg(fig)
+        ax = fig.add_subplot(111)
+        ax.plot(self.series["Date"], self.series["Demand"],
+                color="black", lw=1.2, label="actual")
+        ranked = [
+            m for m in self.scores["model"]
+            if m in set(self.curves["model"])
+        ][:top_k]
+        for name in ranked:
+            sub = self.curves[self.curves["model"] == name]
+            mse = float(
+                self.scores.loc[self.scores["model"] == name, "mse"].iloc[0]
+            )
+            ax.plot(sub["Date"], sub["prediction"], lw=1.0,
+                    label=f"{name} (mse {mse:.1f})")
+        holdout_start = self.curves["Date"].min()
+        ax.axvline(holdout_start, color="gray", ls="--", lw=0.8)
+        ax.set_title(f"{self.product} / {self.sku} — holdout comparison")
+        ax.legend(loc="best", fontsize=8)
+        fig.autofmt_xdate()
+        fig.tight_layout()
+        fig.savefig(path, dpi=120)
 
 
 def extract_sku_series(
@@ -97,6 +137,7 @@ def run_eda(
     rstate: int = 123,
     cfg: SarimaxConfig | None = None,
     polish: bool = False,
+    return_curves: bool = False,
 ) -> EdaReport:
     """Fit every candidate model on one SKU and score the holdout window.
 
@@ -126,6 +167,7 @@ def run_eda(
     y_train, y_score = y[:n_train], y[n_train:]
 
     rows: list[dict] = []
+    curves: dict[str, np.ndarray] = {}
 
     # -- Holt-Winters variants (Box-Cox on, as in the notebook) ----------
     for name, kw in HW_VARIANTS.items():
@@ -135,6 +177,7 @@ def run_eda(
             )
             fc = np.asarray(holt_winters_forecast(fit, horizon))
             rows.append({"model": name, "mse": _holdout_mse(fc, y_score)})
+            curves[name] = fc
         except ValueError as e:  # too short for 2 seasons
             rows.append({"model": name, "mse": float("nan"), "note": str(e)})
 
@@ -155,16 +198,18 @@ def run_eda(
         refined, _ = sarimax_polish(c, params, y[:n_train], ex[:n_train], o)
         return refined
 
-    def sarimax_mse(use_exog: bool) -> float:
+    def sarimax_mse(use_exog: bool) -> tuple[float, np.ndarray]:
         c = cfg if use_exog else cfg_no_exog
         ex = exog if use_exog else np.zeros((len(y), 0), np.float32)
         fit = sarimax_fit(c, y, ex, order, n_train)
         params = _maybe_polish(c, fit.params, ex, order)
         pred = np.asarray(sarimax_predict(c, params, y, ex, order, n_train))
-        return _holdout_mse(pred[n_train:], y_score)
+        return _holdout_mse(pred[n_train:], y_score), pred[n_train:]
 
-    rows.append({"model": "sarimax_exog", "mse": sarimax_mse(True)})
-    rows.append({"model": "sarimax_no_exog", "mse": sarimax_mse(False)})
+    for name, use_exog in (("sarimax_exog", True), ("sarimax_no_exog", False)):
+        mse, pred = sarimax_mse(use_exog)
+        rows.append({"model": name, "mse": mse})
+        curves[name] = pred
 
     # -- TPE over (p, d, q) on the parallel executor ---------------------
     space = {
@@ -186,22 +231,44 @@ def run_eda(
     )
     best_order = (int(best["p"]), int(best["d"]), int(best["q"]))
     best_mse = float(trials.best_trial["result"]["loss"])
-    if polish:
-        # Candidates are scored f32 (speed); the WINNER is re-fit and
-        # polished so the tuned row ranks on the same footing as the
-        # polished fixed-order fits.
+    tuned_name = f"sarimax_tuned{best_order}"
+    if polish or return_curves:
+        # Candidates are scored f32 (speed); the WINNER is re-fit (and
+        # with polish=True f64-refined) so the tuned row ranks on the
+        # same footing and has a prediction curve to report.
         o = np.asarray(best_order, np.int32)
         fit = sarimax_fit(cfg, y, exog, o, n_train)
         params = _maybe_polish(cfg, fit.params, exog, o)
         pred = np.asarray(sarimax_predict(cfg, params, y, exog, o, n_train))
-        best_mse = _holdout_mse(pred[n_train:], y_score)
-    rows.append({"model": f"sarimax_tuned{best_order}", "mse": best_mse})
+        curves[tuned_name] = pred[n_train:]
+        if polish:
+            best_mse = _holdout_mse(pred[n_train:], y_score)
+    rows.append({"model": tuned_name, "mse": best_mse})
 
     scores = pd.DataFrame(rows).sort_values("mse").reset_index(drop=True)
+    curves_frame = series_frame = None
+    if return_curves:
+        score_dates = series["Date"].iloc[n_train:].reset_index(drop=True)
+        curves_frame = pd.concat(
+            [
+                pd.DataFrame(
+                    {
+                        "Date": score_dates,
+                        "model": name,
+                        "prediction": np.asarray(pred, np.float64),
+                    }
+                )
+                for name, pred in curves.items()
+            ],
+            ignore_index=True,
+        )
+        series_frame = series[["Date", "Demand"]].reset_index(drop=True)
     return EdaReport(
         product=str(series["Product"].iloc[0]),
         sku=str(series["SKU"].iloc[0]),
         scores=scores,
         best_order=best_order,
         best_order_mse=best_mse,
+        curves=curves_frame,
+        series=series_frame,
     )

@@ -85,3 +85,38 @@ def test_extract_sku_respects_product_without_sku():
     assert s["SKU"].unique().tolist() == ["b1"]
     with pytest.raises(ValueError, match="Product='C'"):
         extract_sku_series(df, product="C")
+
+
+@pytest.mark.slow
+def test_run_eda_curves_and_plot(devices8, demand_df, tmp_path):
+    # return_curves carries the holdout predictions behind the reference
+    # notebook's comparison plots; EdaReport.plot writes the figure.
+    report = run_eda(
+        demand_df,
+        horizon=20,
+        seasonal_periods=26,
+        max_evals=2,
+        parallelism=2,
+        cfg=CFG_SMALL,
+        return_curves=True,
+    )
+    assert report.curves is not None and report.series is not None
+    models = set(report.curves["model"])
+    assert {"sarimax_exog", "sarimax_no_exog"} <= models
+    assert any(m.startswith("sarimax_tuned") for m in models)
+    # Every curve spans exactly the holdout window.
+    counts = report.curves.groupby("model").size()
+    assert (counts == 20).all(), counts
+    assert np.isfinite(report.curves["prediction"]).all()
+
+    out = tmp_path / "eda.png"
+    report.plot(str(out))
+    assert out.exists() and out.stat().st_size > 5_000
+
+    # Without curves, plot refuses clearly.
+    bare = run_eda(
+        demand_df, horizon=20, seasonal_periods=26, max_evals=2,
+        parallelism=2, cfg=CFG_SMALL,
+    )
+    with pytest.raises(ValueError, match="return_curves"):
+        bare.plot(str(out))
