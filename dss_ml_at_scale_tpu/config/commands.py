@@ -358,7 +358,6 @@ def _cmd_train(args: argparse.Namespace) -> int:
 
     from ..data import DeltaTable, batch_loader
     from ..data.transform import imagenet_transform_spec
-    from ..models import ResNet50
     from ..parallel import ClassifierTask, Trainer, TrainerConfig
     from ..runtime import initialize_distributed, local_topology, make_mesh
 
@@ -402,19 +401,13 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     "torch_padding": torch_padding,
                     "model": args.model,
                     "num_classes": args.num_classes,
+                    "crop": args.crop,
                 }
             )
         )
-    if args.model == "resnet50":
-        model = ResNet50(num_classes=args.num_classes, torch_padding=torch_padding)
-    else:
-        from ..models.resnet import ResNet, ResNetBlock
-
-        model = ResNet(
-            stage_sizes=[1, 1], block_cls=ResNetBlock,
-            num_classes=args.num_classes, num_filters=8,
-            torch_padding=torch_padding,
-        )
+    model = _build_classifier_model(
+        args.model, num_classes=args.num_classes, torch_padding=torch_padding
+    )
     task = ClassifierTask(model=model, tx=optax.adam(args.learning_rate))
 
     init_state = None
@@ -511,6 +504,147 @@ def _has_checkpoint(args: argparse.Namespace) -> bool:
         return ocp.CheckpointManager(ckpt.absolute()).latest_step() is not None
     except Exception:
         return False
+
+
+# --------------------------------------------------------------------------
+# predict (beyond parity: score a Delta table with a trained checkpoint)
+# --------------------------------------------------------------------------
+
+def _build_classifier_model(name: str, *, num_classes: int,
+                            torch_padding: bool):
+    """The train/predict-shared model factory ("resnet50" | "tiny")."""
+    from ..models import ResNet50
+
+    if name == "resnet50":
+        return ResNet50(num_classes=num_classes, torch_padding=torch_padding)
+    from ..models.resnet import ResNet, ResNetBlock
+
+    return ResNet(
+        stage_sizes=[1, 1], block_cls=ResNetBlock,
+        num_classes=num_classes, num_filters=8,
+        torch_padding=torch_padding,
+    )
+
+
+def register_predict(sub: argparse._SubParsersAction) -> None:
+    pr = sub.add_parser(
+        "predict",
+        help="classify a Delta table of images with a trained checkpoint "
+        "and write predictions to a Delta table",
+    )
+    pr.add_argument("--data", required=True, help="Delta table (content/label_index)")
+    pr.add_argument(
+        "--checkpoint-dir", required=True,
+        help="a dsst train checkpoint dir (model architecture is read "
+        "from its dsst_model.json)",
+    )
+    pr.add_argument("--out", required=True, help="predictions Delta table")
+    pr.add_argument(
+        "--step", type=int, default=None,
+        help="explicit checkpoint step (default: the best step by the "
+        "tracked metric, else the latest)",
+    )
+    pr.add_argument("--batch-size", type=int, default=64)
+    pr.add_argument("--crop", type=int, default=None,
+                    help="default: the crop persisted in dsst_model.json, "
+                    "else 224")
+    pr.add_argument("--decode-backend", choices=["auto", "native", "pil"],
+                    default="auto")
+    pr.set_defaults(fn=_cmd_predict)
+
+
+def _cmd_predict(args: argparse.Namespace) -> int:
+    import numpy as np
+    import pyarrow as pa
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..data import DeltaTable, batch_loader, write_delta
+    from ..data.transform import imagenet_transform_spec
+    from ..parallel import ClassifierTask, restore_state
+
+    meta_path = Path(args.checkpoint_dir) / "dsst_model.json"
+    if not meta_path.exists():
+        print(f"no dsst_model.json under {args.checkpoint_dir}; "
+              "was this checkpoint written by dsst train?")
+        return 1
+    meta = json.loads(meta_path.read_text())
+    crop = args.crop or int(meta.get("crop", 224))
+    model = _build_classifier_model(
+        meta.get("model", "resnet50"),
+        num_classes=int(meta["num_classes"]),
+        torch_padding=bool(meta.get("torch_padding", False)),
+    )
+    task = ClassifierTask(model=model)
+
+    table = DeltaTable(args.data)
+    spec = imagenet_transform_spec(crop=crop, backend=args.decode_backend)
+    predict = None
+    rows_label: list[np.ndarray] = []
+    rows_pred: list[np.ndarray] = []
+    rows_prob: list[np.ndarray] = []
+    state = None
+    correct = total = 0
+    with batch_loader(
+        table, batch_size=args.batch_size, num_epochs=1,
+        transform_spec=spec, shuffle_row_groups=False, drop_last=False,
+        # One worker: multi-threaded readers stream row groups in
+        # ARRIVAL order, which would make the emitted "row" index a lie.
+        # With one worker and shuffling off, rows stream in table order.
+        workers_count=1,
+    ) as reader:
+        for batch in reader:
+            if predict is None:
+                state, step = restore_state(
+                    task, batch, args.checkpoint_dir, step=args.step
+                )
+                # Inference never touches the optimizer; free its memory
+                # (the structure-matched restore still had to read it).
+                params, batch_stats = state.params, state.batch_stats
+                state = None
+
+                @jax.jit
+                def predict(batch):
+                    logits = model.apply(
+                        {"params": params, "batch_stats": batch_stats},
+                        task._images(batch), train=False,
+                    )
+                    probs = jax.nn.softmax(logits.astype("float32"), axis=-1)
+                    return jnp.argmax(probs, axis=-1), jnp.max(probs, axis=-1)
+
+            pred, prob = predict(batch)
+            pred, prob = np.asarray(pred), np.asarray(prob)
+            labels = np.asarray(batch["label"])
+            rows_label.append(labels)
+            rows_pred.append(pred)
+            rows_prob.append(prob)
+            correct += int((pred == labels).sum())
+            total += len(pred)
+
+    if total == 0:
+        print("no rows to score")
+        return 1
+    out_table = pa.table(
+        {
+            "row": pa.array(np.arange(total, dtype=np.int64)),
+            "label_index": pa.array(np.concatenate(rows_label).astype(np.int64)),
+            "pred_index": pa.array(np.concatenate(rows_pred).astype(np.int64)),
+            "pred_prob": pa.array(np.concatenate(rows_prob).astype(np.float64)),
+        }
+    )
+    write_delta(out_table, args.out)
+    print(
+        json.dumps(
+            {
+                "rows": total,
+                "checkpoint_step": step,
+                "accuracy_vs_label_index": round(correct / total, 4),
+                "out": str(args.out),
+            }
+        )
+    )
+    return 0
 
 
 # --------------------------------------------------------------------------
@@ -824,6 +958,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_eda(sub)
     register_ingest(sub)
     register_train(sub)
+    register_predict(sub)
     register_lm(sub)
     register_hpo(sub)
     register_trial_worker(sub)

@@ -16,6 +16,7 @@ from .trainer import (  # noqa: F401
     Trainer,
     TrainerConfig,
     TrainState,
+    restore_state,
 )
 from .trials import (  # noqa: F401
     DeviceTrials,

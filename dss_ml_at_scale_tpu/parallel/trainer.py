@@ -637,6 +637,57 @@ def _zero1_shardings(opt_state, mesh: Mesh, axis: str):
     return jax.tree_util.tree_map(leaf, opt_state)
 
 
+def restore_state(
+    task,
+    sample_batch: Batch,
+    checkpoint_dir: str,
+    *,
+    step: int | None = None,
+    prefer: str = "best",
+    best_metric: str | None = None,
+    best_mode: str | None = None,
+    rng: jax.Array | None = None,
+) -> tuple[TrainState, int]:
+    """Restore a Trainer checkpoint outside the Trainer (inference/export).
+
+    ``prefer="best"`` picks the best step by the tracked metric (task
+    defaults apply) and falls back to the latest step when no metrics
+    were saved; ``step=`` pins an explicit step. Returns
+    ``(state, step_restored)``.
+
+    The restore is structure-matched against the task's full TrainState,
+    optimizer state included (orbax restores whole templates) — callers
+    that only infer should drop ``state.opt_state`` right away to free
+    the extra ~2x-params memory.
+    """
+    if prefer not in ("best", "latest"):
+        raise ValueError(f"prefer must be 'best' or 'latest', got {prefer!r}")
+    ocp = _ocp()
+    metric = best_metric or getattr(task, "default_best_metric", "val_acc")
+    mode = best_mode or getattr(task, "default_best_mode", "max")
+    manager = ocp.CheckpointManager(
+        Path(checkpoint_dir).absolute(),
+        options=ocp.CheckpointManagerOptions(
+            best_fn=lambda m: m[metric], best_mode=mode,
+            # Read-only usage: never prune on restore.
+            max_to_keep=None,
+        ),
+    )
+    if step is None:
+        step = manager.best_step() if prefer == "best" else None
+        if step is None:
+            step = manager.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints under {checkpoint_dir}")
+    state = task.init_state(
+        rng if rng is not None else jax.random.key(0), sample_batch
+    )
+    restored = manager.restore(
+        step, args=ocp.args.StandardRestore(_to_pytree(state))
+    )
+    return TrainState(**restored), int(step)
+
+
 def _ocp():
     import orbax.checkpoint as ocp
 

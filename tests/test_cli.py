@@ -357,3 +357,48 @@ def test_info_probe_reports_instead_of_hanging(capsys, monkeypatch):
     rc = main(["info", "--probe", "0.5"])
     out = capsys.readouterr().out
     assert rc == 3 and "unreachable" in out and "0.5s" in out
+
+
+@pytest.mark.slow
+def test_predict_cli_round_trip(tmp_path, capsys, devices8):
+    # train -> checkpoint -> predict: the full use loop. The quadrant
+    # task is learnable, so predictions should beat chance on the
+    # training table itself.
+    from test_end_to_end import _jpeg
+    import pyarrow as pa
+
+    from dss_ml_at_scale_tpu.data import write_delta
+    from dss_ml_at_scale_tpu.config.commands import _read_delta_pandas
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 64)
+    table = pa.table({
+        "content": pa.array([_jpeg(rng, l) for l in labels], type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    data = tmp_path / "images"
+    write_delta(table, data, max_rows_per_file=16)
+
+    ckpt = tmp_path / "ckpt"
+    assert main([
+        "train", "--data", str(data), "--model", "tiny",
+        "--num-classes", "4", "--crop", "64", "--batch-size", "16",
+        "--epochs", "3", "--learning-rate", "0.01",
+        "--checkpoint-dir", str(ckpt),
+        "--val-data", str(data),
+    ]) == 0
+    capsys.readouterr()
+
+    out = tmp_path / "preds"
+    assert main([
+        "predict", "--data", str(data), "--checkpoint-dir", str(ckpt),
+        "--out", str(out), "--batch-size", "24",  # exercises drop_last=False
+    ]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["rows"] == 64
+    assert summary["accuracy_vs_label_index"] > 0.5  # chance = 0.25
+
+    preds = _read_delta_pandas(out)
+    assert len(preds) == 64
+    assert set(preds.columns) == {"row", "label_index", "pred_index", "pred_prob"}
+    assert preds["pred_prob"].between(0, 1).all()
