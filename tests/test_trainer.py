@@ -259,3 +259,37 @@ def test_checkpoint_portable_across_mesh_sizes(devices8, task, tmp_path):
     assert leaves and all(
         set(l.sharding.device_set) <= set(jax.devices()[:2]) for l in leaves
     )
+
+
+@pytest.mark.slow
+def test_restore_state_prefer_and_pin(devices8, task, tmp_path):
+    # restore_state: best-by-metric (default), explicit step pin, latest
+    # fallback, and the missing-dir error.
+    from dss_ml_at_scale_tpu.parallel import restore_state
+
+    cfg = dict(
+        steps_per_epoch=5,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        limit_val_batches=2,
+    )
+    trainer = Trainer(TrainerConfig(max_epochs=2, **cfg), mesh=make_mesh())
+    r = trainer.fit(task, iter(synthetic_batches(10)),
+                    val_data_factory=lambda: synthetic_batches(2, seed=7))
+    sample = synthetic_batches(1)[0]
+
+    best_state, best_step = restore_state(task, sample, cfg["checkpoint_dir"])
+    assert best_step == r.best_checkpoint_step
+    assert int(best_state.step) == best_step
+
+    latest_state, latest_step = restore_state(
+        task, sample, cfg["checkpoint_dir"], prefer="latest"
+    )
+    assert latest_step == 10 and int(latest_state.step) == 10
+
+    pinned, s = restore_state(task, sample, cfg["checkpoint_dir"], step=5)
+    assert s == 5 and int(pinned.step) == 5
+
+    with pytest.raises(FileNotFoundError):
+        restore_state(task, sample, str(tmp_path / "nope"))
+    with pytest.raises(ValueError, match="prefer"):
+        restore_state(task, sample, cfg["checkpoint_dir"], prefer="oldest")
