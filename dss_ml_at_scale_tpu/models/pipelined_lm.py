@@ -1,0 +1,219 @@
+"""Pipeline-parallel Transformer LM: the block stack rides the GPipe ring.
+
+Composition of the two beyond-parity pieces (SURVEY.md §2.3 lists PP as
+absent from the reference): ``TransformerBlock``s are the uniform-width
+stages of :func:`~dss_ml_at_scale_tpu.parallel.pipeline.spmd_pipeline`
+— one layer's parameters resident per "pipe" device, microbatches of
+embedded activations hopping the ``ppermute`` ring — while the token/
+position embeddings and the (untied) head run replicated outside the
+pipeline (they are cheap relative to the stack and keep the GPipe
+equal-shape contract clean).
+
+``PipelinedLM`` is deliberately NOT a flax module: the stage stacking,
+mesh binding, and replicated prologue/epilogue are explicit, so the
+whole model is a pytree of arrays plus pure functions — the same shape
+as the rest of the framework's jitted programs. ``PipelinedLMTask``
+adapts it to the standard Trainer via the ``state_shardings`` /
+``batch_size_of`` hooks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.flash_attention import attention_reference
+from ..parallel.pipeline import (
+    moment_sharding,
+    spmd_pipeline,
+    stack_stage_params,
+    stage_sharding,
+)
+from .transformer import TransformerBlock, next_token_loss, rms_norm  # noqa: F401
+
+
+@dataclasses.dataclass
+class PipelinedLM:
+    """Decoder-only LM with its layer stack pipelined over a mesh axis.
+
+    ``n_stages = mesh.shape[axis_name]`` transformer blocks, one per
+    pipe device. Batches are microbatched: ``tokens`` arrive as
+    ``[n_micro, micro_batch, seq]`` int32.
+    """
+
+    vocab_size: int
+    dim: int
+    num_heads: int
+    mesh: Mesh
+    axis_name: str = "pipe"
+    batch_axis: str | None = None
+    max_seq: int = 512
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        self.n_stages = self.mesh.shape[self.axis_name]
+        for name, val in (("vocab_size", self.vocab_size),
+                          ("max_seq", self.max_seq), ("dim", self.dim)):
+            # The optimizer-moment sharding heuristic keys on a leading
+            # dim equal to n_stages; a collision would mis-shard.
+            if val == self.n_stages:
+                raise ValueError(
+                    f"{name}={val} equals the pipe stage count; pick a "
+                    "different size (stage-dim detection would collide)"
+                )
+        self._block = TransformerBlock(
+            num_heads=self.num_heads,
+            dtype=self.dtype,
+            mlp_ratio=self.mlp_ratio,
+            attention_fn=lambda q, k, v: attention_reference(
+                q, k, v, causal=True
+            ),
+        )
+        self._run = spmd_pipeline(
+            lambda p, x: self._block.apply({"params": p}, x),
+            self.mesh,
+            self.axis_name,
+            self.batch_axis,
+        )
+
+    # -- params -----------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> dict:
+        k_tok, k_pos, k_stage, k_head = jax.random.split(rng, 4)
+
+        def init_stage(r):
+            return self._block.init(
+                r, jnp.zeros((1, self.max_seq, self.dim), self.dtype)
+            )["params"]
+
+        return {
+            "tok": 0.02 * jax.random.normal(
+                k_tok, (self.vocab_size, self.dim), jnp.float32
+            ),
+            "pos": 0.02 * jax.random.normal(
+                k_pos, (self.max_seq, self.dim), jnp.float32
+            ),
+            "stages": stack_stage_params(init_stage, k_stage, self.n_stages),
+            "norm_scale": jnp.ones((self.dim,), jnp.float32),
+            "head": 0.02 * jax.random.normal(
+                k_head, (self.dim, self.vocab_size), jnp.float32
+            ),
+        }
+
+    def param_shardings(self, params: dict) -> dict:
+        """Stages live on the pipe axis; everything else replicates."""
+        replicated = NamedSharding(self.mesh, P())
+        out = {
+            k: jax.tree_util.tree_map(lambda _: replicated, v)
+            for k, v in params.items()
+            if k != "stages"
+        }
+        out["stages"] = stage_sharding(
+            params["stages"], self.mesh, self.axis_name
+        )
+        return out
+
+    # -- forward ----------------------------------------------------------
+
+    def apply(self, params: dict, tokens: jax.Array) -> jax.Array:
+        """``[n_micro, mb, seq]`` int32 → ``[n_micro, mb, seq, vocab]``."""
+        m, mb, s = tokens.shape
+        if s > self.max_seq:
+            raise ValueError(f"seq {s} > max_seq {self.max_seq}")
+        x = (
+            params["tok"].astype(self.dtype)[tokens]
+            + params["pos"][None, None, :s].astype(self.dtype)
+        )
+        # [n_micro, mb, s, d] through the stage ring; the pipeline treats
+        # axis 0 as the microbatch schedule and shards axis 1 over
+        # batch_axis when configured.
+        y = self._run(params["stages"], x)
+        y32 = rms_norm(y.astype(jnp.float32), params["norm_scale"])
+        return y32 @ params["head"]
+
+
+@dataclasses.dataclass
+class PipelinedLMTask:
+    """Trainer task: next-token loss over the pipelined LM."""
+
+    model: PipelinedLM
+    tx: Any = None
+    learning_rate: float = 3e-4
+    tokens_key: str = "tokens"
+
+    default_best_metric = "val_loss"
+    default_best_mode = "min"
+
+    def __post_init__(self):
+        if self.tx is None:
+            import optax
+
+            self.tx = optax.adam(self.learning_rate)
+
+    def batch_size_of(self, batch) -> int:
+        t = batch[self.tokens_key]
+        return int(t.shape[0]) * int(t.shape[1])
+
+    def init_state(self, rng, sample_batch):
+        from ..parallel.trainer import TrainState
+
+        params = self.model.init(rng)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats={},
+            opt_state=self.tx.init(params),
+        )
+
+    def state_shardings(self, state, mesh: Mesh):
+        if dict(mesh.shape) != dict(self.model.mesh.shape):
+            raise ValueError(
+                f"Trainer mesh {dict(mesh.shape)} != model mesh "
+                f"{dict(self.model.mesh.shape)}"
+            )
+        replicated = NamedSharding(mesh, P())
+        return type(state)(
+            step=replicated,
+            params=self.model.param_shardings(state.params),
+            batch_stats={},
+            # Leading-dim==n_stages detection is safe: __post_init__
+            # rejects vocab/max_seq/dim colliding with the stage count.
+            opt_state=moment_sharding(
+                state.opt_state, mesh, self.model.axis_name,
+                self.model.n_stages,
+            ),
+        )
+
+    def _loss(self, params, tokens):
+        logits = self.model.apply(params, tokens)
+        m, mb, s, v = logits.shape
+        return next_token_loss(
+            logits.reshape(m * mb, s, v), tokens.reshape(m * mb, s)
+        )
+
+    def train_step(self, state, batch):
+        import optax
+
+        tokens = jnp.asarray(batch[self.tokens_key])
+        loss, grads = jax.value_and_grad(self._loss)(state.params, tokens)
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            type(state)(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=state.batch_stats,
+                opt_state=new_opt,
+            ),
+            {"train_loss": loss, "train_ppl": jnp.exp(loss)},
+        )
+
+    def eval_step(self, state, batch):
+        tokens = jnp.asarray(batch[self.tokens_key])
+        loss = self._loss(state.params, tokens)
+        return {"val_loss": loss, "val_ppl": jnp.exp(loss)}

@@ -24,6 +24,16 @@ from flax import linen as nn
 from ..ops.flash_attention import attention_reference, flash_attention
 
 
+def rms_norm(x, scale, eps: float = 1e-6):
+    """The pure RMSNorm expression (f32 math), shared by the flax module
+    and non-flax models (PipelinedLM)."""
+    x32 = x.astype(jnp.float32)
+    norm = x32 * jax.lax.rsqrt(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps
+    )
+    return norm * scale
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-6
     dtype: Any = jnp.float32
@@ -31,11 +41,7 @@ class RMSNorm(nn.Module):
     @nn.compact
     def __call__(self, x):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
-        x32 = x.astype(jnp.float32)
-        norm = x32 * jax.lax.rsqrt(
-            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
-        )
-        return (norm * scale).astype(self.dtype)
+        return rms_norm(x, scale, self.eps).astype(self.dtype)
 
 
 def _select_attention(kind: str, **ring_kwargs) -> Callable:

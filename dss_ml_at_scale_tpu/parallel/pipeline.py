@@ -168,6 +168,28 @@ def pipeline_utilization(n_micro: int, n_stages: int) -> float:
     return n_micro / (n_micro + n_stages - 1)
 
 
+def moment_sharding(tree, mesh: Mesh, axis_name: str, n_stages: int):
+    """Sharding tree for optimizer state mirroring stacked stage params.
+
+    Adam moments mirror param shapes, so any leaf with a leading
+    ``n_stages`` dim is a stage stack (callers must guarantee no other
+    leaf leads with that size — see PipelinedLM's collision guard);
+    scalars and optax counters replicate.
+    """
+    replicated = NamedSharding(mesh, P())
+
+    def leaf(l):
+        ndim = getattr(l, "ndim", 0)
+        shape = getattr(l, "shape", ())
+        if ndim >= 1 and shape[0] == n_stages:
+            return NamedSharding(
+                mesh, P(axis_name, *([None] * (ndim - 1)))
+            )
+        return replicated
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 class PipelinedTask:
     """Pipeline-parallel regression task for the standard Trainer loop.
 
@@ -228,28 +250,15 @@ class PipelinedTask:
                 f"{dict(self.mesh.shape)}; construct the task with the "
                 "Trainer's mesh"
             )
-        stage = stage_sharding(state.params, mesh, self.axis_name)
         replicated = NamedSharding(mesh, P())
-
-        def moments(tree):
-            # optax state leaves either mirror the stacked param shapes
-            # (Adam m/v) or are scalars/counters.
-            def leaf(l):
-                ndim = getattr(l, "ndim", 0)
-                shape = getattr(l, "shape", ())
-                if ndim >= 1 and shape[0] == self.n_stages:
-                    return NamedSharding(
-                        mesh, P(self.axis_name, *([None] * (ndim - 1)))
-                    )
-                return replicated
-            return jax.tree_util.tree_map(leaf, tree)
-
         return type(state)(
             step=replicated,
-            params=stage,
+            params=stage_sharding(state.params, mesh, self.axis_name),
             batch_stats=jax.tree_util.tree_map(lambda _: replicated,
                                                state.batch_stats),
-            opt_state=moments(state.opt_state),
+            opt_state=moment_sharding(
+                state.opt_state, mesh, self.axis_name, self.n_stages
+            ),
         )
 
     def train_step(self, state, batch):
