@@ -26,7 +26,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.flash_attention import attention_reference
 from ..parallel.pipeline import (
     moment_sharding,
     spmd_pipeline,
@@ -66,13 +65,15 @@ class PipelinedLM:
                     f"{name}={val} equals the pipe stage count; pick a "
                     "different size (stage-dim detection would collide)"
                 )
+        from .transformer import _select_attention
+
         self._block = TransformerBlock(
             num_heads=self.num_heads,
             dtype=self.dtype,
             mlp_ratio=self.mlp_ratio,
-            attention_fn=lambda q, k, v: attention_reference(
-                q, k, v, causal=True
-            ),
+            # Plain-XLA attention: the stage runs inside shard_map + scan,
+            # where the differentiable merge-free backend is the safe one.
+            attention_fn=_select_attention("reference"),
         )
         self._run = spmd_pipeline(
             lambda p, x: self._block.apply({"params": p}, x),

@@ -4,6 +4,7 @@ ring attention (sequence parallelism), GPipe-style pipeline parallelism."""
 from .ring import ring_attention  # noqa: F401
 from .pipeline import (  # noqa: F401
     PipelinedTask,
+    moment_sharding,
     pipeline_utilization,
     spmd_pipeline,
     stack_stage_params,

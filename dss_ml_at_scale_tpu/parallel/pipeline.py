@@ -47,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "PipelinedTask",
+    "moment_sharding",
     "pipeline_utilization",
     "spmd_pipeline",
     "stack_stage_params",
