@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# One-shot on-chip artifact refresh for when the accelerator tunnel is up:
+#   ./run_tpu_artifacts.sh [out_suffix]
+# Runs the headline bench (probe-gated, watchdogged) and the accuracy
+# proof on the real chip, writing BENCH_local{suffix}.json and
+# ACCURACY_r03.json. Safe to run against a dead tunnel: the bench
+# degrades with a diagnosis in ~25 min instead of hanging.
+set -u
+cd "$(dirname "$0")"
+SUFFIX="${1:-}"
+
+echo "== probe =="
+timeout 150 python - <<'EOF'
+import jax
+d = jax.devices()[0]
+print(f"platform={d.platform} device={d.device_kind}")
+EOF
+PROBE_RC=$?
+if [ $PROBE_RC -ne 0 ]; then
+  echo "tunnel unreachable (rc=$PROBE_RC); bench will record the failure"
+fi
+
+echo "== bench =="
+timeout 3600 python bench.py > "BENCH_local${SUFFIX}.json" 2> "bench_stderr.log"
+echo "bench rc=$? -> BENCH_local${SUFFIX}.json"
+tail -c 600 "BENCH_local${SUFFIX}.json" || true
+echo
+
+if [ $PROBE_RC -eq 0 ]; then
+  echo "== accuracy proof on chip =="
+  timeout 1800 python bench_accuracy.py --out ACCURACY_r03.json
+  echo "accuracy rc=$?"
+fi
