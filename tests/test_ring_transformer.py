@@ -192,3 +192,47 @@ def test_lm_sp_trains_under_trainer(devices8, seq_mesh):
         specs={"tokens": P(None, "sp")},
     )
     assert not placed["tokens"].sharding.is_fully_replicated
+
+
+def test_transformer_lm_bf16_default_path(rng):
+    # The TPU-default dtype (bf16 activations, f32 logits) must produce
+    # finite logits close to the f32 reference — the MXU-native
+    # configuration every accelerator run uses.
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32)
+
+    def build(dtype):
+        return TransformerLM(
+            vocab_size=64, dim=32, num_heads=4, num_layers=2, max_seq=32,
+            dtype=dtype, attention="reference",
+        )
+
+    lm16, lm32 = build(jnp.bfloat16), build(jnp.float32)
+    params = lm32.init(jax.random.key(0), tokens)  # f32 master weights
+    out16 = lm16.apply(params, tokens)
+    out32 = lm32.apply(params, tokens)
+    assert out16.dtype == jnp.float32  # logits always f32
+    assert np.isfinite(np.asarray(out16)).all()
+    # bf16 has ~3 decimal digits; compare post-softmax where it matters.
+    p16 = jax.nn.softmax(out16, axis=-1)
+    p32 = jax.nn.softmax(out32, axis=-1)
+    assert float(jnp.abs(p16 - p32).max()) < 0.05
+    loss16 = float(next_token_loss(out16, tokens))
+    loss32 = float(next_token_loss(out32, tokens))
+    assert abs(loss16 - loss32) < 0.05 * max(1.0, loss32)
+
+
+def test_ring_attention_bf16(rng, seq_mesh):
+    q, k, v = _qkv(rng, s=256, dtype=jnp.bfloat16)
+    out = jax.jit(
+        lambda q, k, v: ring_attention(
+            q, k, v, mesh=seq_mesh, axis_name="sp", causal=True
+        )
+    )(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = attention_reference(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=2e-2, rtol=2e-2
+    )
