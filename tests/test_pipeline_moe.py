@@ -425,3 +425,23 @@ def test_pipelined_lm_trains_under_trainer(rng, pipe_mesh):
     # Stage params live on the pipe axis, not replicated.
     leaf = jax.tree_util.tree_leaves(result.state.params["stages"])[0]
     assert "pipe" in (leaf.sharding.spec[0] or ())
+
+
+def test_moe_bf16_default_dtype(rng):
+    # The layer's default (bf16, MXU-native) must route identically to
+    # f32 (routing is f32 by construction) and produce finite outputs
+    # close to the f32 compute.
+    x = jnp.asarray(rng.normal(size=(2, 16, 8)), jnp.float32)
+    moe16 = MoEMLP(num_experts=4, mlp_ratio=2, capacity_factor=2.0)
+    assert moe16.dtype == jnp.bfloat16  # the documented default
+    variables = moe16.init(jax.random.key(7), x)
+    out16, _ = moe16.apply(variables, x, mutable=["intermediates"])
+    assert np.isfinite(np.asarray(out16, np.float32)).all()
+
+    moe32 = MoEMLP(num_experts=4, mlp_ratio=2, capacity_factor=2.0,
+                   dtype=jnp.float32)
+    out32, _ = moe32.apply(variables, x, mutable=["intermediates"])
+    np.testing.assert_allclose(
+        np.asarray(out16, np.float32), np.asarray(out32),
+        atol=0.05, rtol=0.05,
+    )
