@@ -311,7 +311,6 @@ def make_batch_reader(paths_or_table, **kwargs) -> ParquetShardReader:
     elif isinstance(paths_or_table, (list, tuple)):
         paths = list(paths_or_table)
     else:
-        import os
         from pathlib import Path
 
         p = Path(paths_or_table)

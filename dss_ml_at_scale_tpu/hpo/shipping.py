@@ -29,7 +29,6 @@ from __future__ import annotations
 import os
 import threading
 from pathlib import Path
-from typing import Mapping
 
 import numpy as np
 
