@@ -20,7 +20,6 @@ Run from the repo root:  python bench_accuracy.py [--out ACCURACY.json]
 from __future__ import annotations
 
 import argparse
-import io
 import json
 import time
 from pathlib import Path
@@ -28,49 +27,15 @@ from pathlib import Path
 
 def make_dataset(path: Path, n_train: int, n_val: int, classes: int = 10,
                  size: int = 64, seed: int = 0):
-    import numpy as np
-    import pyarrow as pa
-    from PIL import Image
+    # The grating generator lives in the framework proper
+    # (datagen/images.py; also `dsst datagen images`) — this harness just
+    # cuts a train/val pair from it.
+    from dss_ml_at_scale_tpu.datagen.images import write_image_delta
 
-    from dss_ml_at_scale_tpu.data import write_delta
-
-    rng = np.random.default_rng(seed)
-    yy, xx = np.mgrid[0:size, 0:size] / size
-
-    def jpeg(label: int) -> bytes:
-        # Class k = grating at angle k*18° with class-specific frequency;
-        # random phase/contrast/noise per image.
-        angle = label * np.pi / classes
-        freq = 3.0 + 1.5 * (label % 5)
-        phase = rng.uniform(0, 2 * np.pi)
-        g = np.sin(
-            2 * np.pi * freq * (xx * np.cos(angle) + yy * np.sin(angle)) + phase
-        )
-        contrast = rng.uniform(0.5, 1.0)
-        base = 0.5 + 0.4 * contrast * g
-        img = base[..., None] + rng.normal(0, 0.08, (size, size, 3))
-        buf = io.BytesIO()
-        Image.fromarray((img.clip(0, 1) * 255).astype(np.uint8)).save(
-            buf, format="JPEG", quality=90
-        )
-        return buf.getvalue()
-
-    def table(n, seed_labels):
-        labels = np.asarray(seed_labels)
-        return pa.table(
-            {
-                "content": pa.array(
-                    [jpeg(int(l)) for l in labels], type=pa.binary()
-                ),
-                "label_index": pa.array(labels.astype(np.int64)),
-            }
-        )
-
-    train_labels = rng.integers(0, classes, n_train)
-    val_labels = rng.integers(0, classes, n_val)
-    write_delta(table(n_train, train_labels), path / "train",
-                max_rows_per_file=256)
-    write_delta(table(n_val, val_labels), path / "val", max_rows_per_file=256)
+    write_image_delta(path / "train", n_train, classes=classes, size=size,
+                      seed=seed)
+    write_image_delta(path / "val", n_val, classes=classes, size=size,
+                      seed=seed + 1)
 
 
 def main() -> int:

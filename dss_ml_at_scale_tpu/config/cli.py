@@ -16,6 +16,13 @@ def build_parser() -> argparse.ArgumentParser:
         prog="dsst",
         description="dss_ml_at_scale_tpu: TPU-native scale-out ML framework",
     )
+    parser.add_argument(
+        "--platform", default=None, metavar="NAME",
+        help="force the jax platform (e.g. cpu) before any backend use — "
+        "the env var JAX_PLATFORMS is overridden by accelerator plugins "
+        "on some hosts, so this applies the in-process config update "
+        "that actually sticks",
+    )
     sub = parser.add_subparsers(dest="command")
     info = sub.add_parser("info", help="show runtime topology and devices")
     info.add_argument(
@@ -79,6 +86,13 @@ def _cmd_info(args: argparse.Namespace) -> int:
 def main(argv: list[str] | None = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.platform:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", args.platform)
+        except RuntimeError:
+            pass  # backend already initialized (in-process caller)
     if not getattr(args, "fn", None):
         parser.print_help()
         return 2

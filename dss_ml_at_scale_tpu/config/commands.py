@@ -56,6 +56,18 @@ def register_datagen(sub: argparse._SubParsersAction) -> None:
     reg.add_argument("--out", required=True, help="output .npz path")
     reg.set_defaults(fn=_cmd_datagen_regression)
 
+    img = gsub.add_parser(
+        "images",
+        help="labeled JPEG gratings → Delta (quick-start training data; "
+        "each class a distinct orientation/frequency)",
+    )
+    img.add_argument("--out", required=True, help="Delta table path")
+    img.add_argument("--n", type=int, default=1024)
+    img.add_argument("--classes", type=int, default=10)
+    img.add_argument("--size", type=int, default=64)
+    img.add_argument("--seed", type=int, default=0)
+    img.set_defaults(fn=_cmd_datagen_images)
+
 
 def _cmd_datagen_demand(args: argparse.Namespace) -> int:
     # The ARMA sampler runs through JAX; for a datagen-sized workload the
@@ -106,6 +118,20 @@ def _cmd_datagen_regression(args: argparse.Namespace) -> int:
         args.out, X_train=X_train, X_test=X_test, y_train=y_train, y_test=y_test
     )
     print(f"regression: {len(X_train)}+{len(X_test)} samples -> {path}")
+    return 0
+
+
+def _cmd_datagen_images(args: argparse.Namespace) -> int:
+    from ..datagen.images import write_image_delta
+
+    labels = write_image_delta(
+        args.out, args.n, classes=args.classes, size=args.size,
+        seed=args.seed, mode="overwrite",
+    )
+    print(
+        f"images: {len(labels)} JPEGs, {args.classes} classes, "
+        f"{args.size}px -> {args.out}"
+    )
     return 0
 
 

@@ -49,6 +49,12 @@ def register_pipeline(sub: argparse._SubParsersAction) -> None:
     pl.add_argument(
         "--dry-run", action="store_true", help="print the execution plan only"
     )
+    pl.add_argument(
+        "--task-platform", default=None, metavar="PLATFORM",
+        help="force every task's jax platform (prepends the top-level "
+        "--platform flag to each task invocation) — e.g. cpu for CI or "
+        "when the accelerator is unavailable",
+    )
     pl.set_defaults(fn=run_pipeline)
 
 
@@ -85,8 +91,16 @@ def run_pipeline(args: argparse.Namespace) -> int:
     order = _topo_order(spec.get("tasks", []))
     workdir = str(Path(args.workdir).absolute())
 
+    platform_prefix = (
+        ["--platform", args.task_platform]
+        if getattr(args, "task_platform", None)
+        else []
+    )
+
     def render(argv: list[str]) -> list[str]:
-        return [a.replace("{workdir}", workdir) for a in argv]
+        return platform_prefix + [
+            a.replace("{workdir}", workdir) for a in argv
+        ]
 
     if args.dry_run:
         for t in order:

@@ -413,3 +413,42 @@ def test_predict_cli_round_trip(tmp_path, capsys, devices8):
     np.testing.assert_array_equal(
         preds.sort_values("row")["label_index"].to_numpy(), canonical
     )
+
+
+def test_datagen_images(tmp_path, capsys):
+    out = tmp_path / "imgs"
+    assert main([
+        "datagen", "images", "--out", str(out), "--n", "32",
+        "--classes", "4", "--size", "32",
+    ]) == 0
+    assert (out / "_delta_log").is_dir()
+    from dss_ml_at_scale_tpu.config.commands import _read_delta_pandas
+
+    df = _read_delta_pandas(out)
+    assert len(df) == 32
+    assert set(df["label_index"]) <= {0, 1, 2, 3}
+    assert "32 JPEGs" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_imagenet_train_pipeline_spec(tmp_path):
+    # The track-A RUNME analogue: datagen images -> train -> predict as a
+    # real subprocess DAG over the shipped spec.
+    import os
+
+    env = dict(os.environ)
+    # Pipeline tasks run as real subprocesses; they must not claim the
+    # (possibly hung) accelerator tunnel in CI — force CPU + the
+    # simulated slice like conftest does for in-process tests.
+    rc = subprocess.run(
+        [sys.executable, "-m", "dss_ml_at_scale_tpu.config.cli",
+         "pipeline", "--spec", "pipelines/imagenet_train.json",
+         "--workdir", str(tmp_path), "--task-platform", "cpu"],
+        env={**env,
+             "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")},
+        capture_output=True, text=True, timeout=900,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert rc.returncode == 0, rc.stdout[-2000:] + rc.stderr[-2000:]
+    assert (tmp_path / "predictions" / "_delta_log").is_dir()
