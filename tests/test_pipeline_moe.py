@@ -445,3 +445,17 @@ def test_moe_bf16_default_dtype(rng):
         np.asarray(out16, np.float32), np.asarray(out32),
         atol=0.05, rtol=0.05,
     )
+
+
+@pytest.mark.parametrize("n_micro", [1, 3, 8])
+def test_pipeline_micro_count_edges(rng, pipe_mesh, n_micro):
+    # n_micro < n_stages (deep bubble), == and > : the schedule must bank
+    # exactly the n_micro real outputs in every regime.
+    n_stages = pipe_mesh.shape["pipe"]
+    stacked = stack_stage_params(_init_stage, jax.random.key(9), n_stages)
+    stacked = jax.device_put(stacked, stage_sharding(stacked, pipe_mesh, "pipe"))
+    xs = jnp.asarray(rng.normal(size=(n_micro, 4, 16)), jnp.float32)
+    run = spmd_pipeline(_mlp_stage, pipe_mesh, "pipe")
+    out = jax.jit(run)(stacked, xs)
+    ref = _sequential(jax.device_get(stacked), xs, n_stages)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
