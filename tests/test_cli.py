@@ -452,3 +452,20 @@ def test_imagenet_train_pipeline_spec(tmp_path):
     )
     assert rc.returncode == 0, rc.stdout[-2000:] + rc.stderr[-2000:]
     assert (tmp_path / "predictions" / "_delta_log").is_dir()
+
+
+@pytest.mark.slow
+def test_lm_cli_resume(tmp_path, capsys, devices8):
+    # LM checkpoints resume through the same Orbax machinery as train.
+    common = [
+        "lm", "--vocab", "16", "--dim", "16", "--heads", "2",
+        "--layers", "1", "--seq", "16", "--batch-size", "8",
+        "--steps-per-epoch", "10", "--attention", "reference",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ]
+    assert main(common + ["--epochs", "1"]) == 0
+    s1 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert s1["steps"] == 10
+    assert main(common + ["--epochs", "2", "--resume"]) == 0
+    s2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert s2["steps"] == 20  # resumed from 10, ran one more epoch

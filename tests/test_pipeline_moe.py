@@ -459,3 +459,15 @@ def test_pipeline_micro_count_edges(rng, pipe_mesh, n_micro):
     out = jax.jit(run)(stacked, xs)
     ref = _sequential(jax.device_get(stacked), xs, n_stages)
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_single_stage_degenerates_to_apply(rng):
+    # n_stages == 1 (odd device counts fall back to pipe=1): the schedule
+    # must reduce to plain per-microbatch application.
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 8), ("pipe", "data"))
+    stacked = stack_stage_params(_init_stage, jax.random.key(11), 1)
+    xs = jnp.asarray(rng.normal(size=(4, 8, 16)), jnp.float32)
+    run = spmd_pipeline(_mlp_stage, mesh, "pipe", batch_axis="data")
+    out = jax.jit(run)(stacked, xs)
+    ref = _sequential(jax.device_get(stacked), xs, 1)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
