@@ -469,3 +469,14 @@ def test_lm_cli_resume(tmp_path, capsys, devices8):
     assert main(common + ["--epochs", "2", "--resume"]) == 0
     s2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert s2["steps"] == 20  # resumed from 10, ran one more epoch
+
+
+def test_predict_without_model_meta_fails_cleanly(tmp_path, capsys):
+    (tmp_path / "ckpt").mkdir()
+    rc = main([
+        "predict", "--data", str(tmp_path / "d"),
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--out", str(tmp_path / "o"),
+    ])
+    assert rc == 1
+    assert "dsst_model.json" in capsys.readouterr().out
