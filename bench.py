@@ -114,16 +114,19 @@ def _probe_accelerator(notes: list[str]) -> bool:
     pt = float(os.environ.get(_PROBE_TIMEOUT_ENV, "240"))
     for attempt in (1, 2):
         probe, err = _run_child("probe", force_cpu=False, t=pt)
-        if probe is not None and probe.get("platform") not in (None, "cpu"):
-            return True
-        if err is None:
-            # Definitive answer (the default backend IS cpu — no
-            # accelerator on this host): retrying cannot change it.
-            notes.append(
-                f"accelerator probe: platform {probe.get('platform')!r}"
-            )
+        platform = probe.get("platform") if probe is not None else None
+        if platform == "cpu":
+            # The only definitive negative: the default backend IS cpu —
+            # no accelerator on this host; retrying cannot change it.
+            # Anything else (timeout, crash, failed=True, missing
+            # platform) may be a transient tunnel flake and gets a retry.
+            notes.append("accelerator probe: platform 'cpu'")
             return False
-        notes.append(f"accelerator probe {attempt}: {err}")
+        if platform is not None:
+            return True
+        notes.append(
+            f"accelerator probe {attempt}: {err or 'no platform in probe'}"
+        )
         if attempt == 1:
             # Timeout/crash may be a transient tunnel flake — retry after
             # the observed stale-lease recovery time.

@@ -89,10 +89,32 @@ def main(argv: list[str] | None = None) -> int:
     if args.platform:
         import jax
 
+        # Read initialized-ness WITHOUT triggering initialization: a
+        # default_backend() probe here would claim the device (and can
+        # hang on a dead tunnel) before any subcommand watchdog runs.
+        already_up = bool(
+            getattr(
+                getattr(jax, "_src", None) and jax._src.xla_bridge,
+                "_backends",
+                None,
+            )
+        )
         try:
             jax.config.update("jax_platforms", args.platform)
         except RuntimeError:
-            pass  # backend already initialized (in-process caller)
+            pass  # older jax raises once the backend is initialized
+        # Newer jax silently ignores the update after backend init, so
+        # compare the (already-cached, cheap) effective backend; a
+        # caller that asked for cpu must not keep running on the
+        # accelerator unawares. --platform may be a comma-separated
+        # priority list; honored means the winner is any listed entry.
+        if already_up and jax.default_backend() not in args.platform.split(","):
+            print(
+                f"warning: --platform {args.platform} ignored — JAX "
+                f"backend already initialized as "
+                f"{jax.default_backend()!r} in this process",
+                file=sys.stderr,
+            )
     if not getattr(args, "fn", None):
         parser.print_help()
         return 2

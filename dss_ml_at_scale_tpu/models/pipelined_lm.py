@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.pipeline import (
+    check_same_mesh,
     moment_sharding,
     spmd_pipeline,
     stack_stage_params,
@@ -172,11 +173,7 @@ class PipelinedLMTask:
         )
 
     def state_shardings(self, state, mesh: Mesh):
-        if dict(mesh.shape) != dict(self.model.mesh.shape):
-            raise ValueError(
-                f"Trainer mesh {dict(mesh.shape)} != model mesh "
-                f"{dict(self.model.mesh.shape)}"
-            )
+        check_same_mesh(self.model.mesh, mesh, "PipelinedLM")
         replicated = NamedSharding(mesh, P())
         return type(state)(
             step=replicated,

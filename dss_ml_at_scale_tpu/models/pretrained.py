@@ -61,7 +61,7 @@ def load_state_dict(path: str | Path) -> dict[str, np.ndarray]:
 
     try:
         state = torch.load(path, map_location="cpu", weights_only=True)
-    except pickle.UnpicklingError:
+    except pickle.UnpicklingError as exc:
         # Real Lightning checkpoints carry benign non-tensor payloads
         # (hyper_parameters as an argparse.Namespace, optimizer_states)
         # that the strict unpickler rejects. Allowlist Namespace — still
@@ -69,7 +69,14 @@ def load_state_dict(path: str | Path) -> dict[str, np.ndarray]:
         # beyond that should be re-exported as a plain state dict.
         import argparse as _argparse
 
-        with torch.serialization.safe_globals([_argparse.Namespace]):
+        safe_globals = getattr(torch.serialization, "safe_globals", None)
+        if safe_globals is None:  # torch < 2.4
+            raise pickle.UnpicklingError(
+                f"{exc} (this torch lacks torch.serialization.safe_globals;"
+                " re-export the checkpoint as a plain state dict:"
+                " torch.save(model.state_dict(), path))"
+            ) from exc
+        with safe_globals([_argparse.Namespace]):
             state = torch.load(path, map_location="cpu", weights_only=True)
     if isinstance(state, Mapping) and "state_dict" in state:
         state = state["state_dict"]

@@ -47,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "PipelinedTask",
+    "check_same_mesh",
     "moment_sharding",
     "pipeline_utilization",
     "spmd_pipeline",
@@ -55,6 +56,31 @@ __all__ = [
 ]
 
 from ._compat import shard_map_unchecked
+
+
+def check_same_mesh(task_mesh: Mesh, mesh: Mesh, what: str) -> None:
+    """Require ``mesh`` to be the mesh a pipeline schedule was built on.
+
+    A distinct mesh with equal axis sizes but a different device order
+    would pass a shape-only check and then silently place state on one
+    device assignment while ``shard_map`` executes over another —
+    per-step resharding single-host, wrong placement multi-host. Equal
+    axis names AND an identical device array are both required.
+    """
+    import numpy as np
+
+    if mesh is task_mesh:
+        return
+    if dict(mesh.shape) != dict(task_mesh.shape) or not np.array_equal(
+        mesh.devices, task_mesh.devices
+    ):
+        raise ValueError(
+            f"Trainer mesh {dict(mesh.shape)} (devices "
+            f"{mesh.devices.ravel().tolist()}) != {what} mesh "
+            f"{dict(task_mesh.shape)} (devices "
+            f"{task_mesh.devices.ravel().tolist()}); construct the task "
+            "with the Trainer's mesh"
+        )
 
 
 def stack_stage_params(init_fn: Callable[[jax.Array], Any], rng: jax.Array,
@@ -242,15 +268,10 @@ class PipelinedTask:
     def state_shardings(self, state, mesh: Mesh):
         """Stage-shard params AND the mirrored optimizer moments; scalars
         (step, optax counters) replicate."""
-        if mesh is not self.mesh and dict(mesh.shape) != dict(self.mesh.shape):
-            # The schedule (self.run) was built against self.mesh; a
-            # Trainer running a different mesh would place state on one
-            # mesh and execute shard_map over another.
-            raise ValueError(
-                f"Trainer mesh {dict(mesh.shape)} != PipelinedTask mesh "
-                f"{dict(self.mesh.shape)}; construct the task with the "
-                "Trainer's mesh"
-            )
+        # The schedule (self.run) was built against self.mesh; a Trainer
+        # running a different mesh would place state on one mesh and
+        # execute shard_map over another.
+        check_same_mesh(self.mesh, mesh, "PipelinedTask")
         replicated = NamedSharding(mesh, P())
         return type(state)(
             step=replicated,

@@ -108,7 +108,38 @@ def shard_batch_to_mesh(batch, mesh: Mesh, axis: str = "data", specs=None):
     sequence-parallel training. ``batch`` must be a Mapping when
     ``specs`` is given.
     """
+    def _local_slice(shard_factor: int) -> int:
+        # Each process contributes its local rows, so the divisibility
+        # that matters is against the local slice of the shard factor
+        # (the global factor in single-process runs).
+        if jax.process_count() > 1 and shard_factor % jax.process_count() == 0:
+            return shard_factor // jax.process_count()
+        return shard_factor
+
     def _place_spec(x, spec):
+        # Validate up front — an axis name missing from the mesh or an
+        # indivisible sharded dim otherwise surfaces as an opaque XLA /
+        # NamedSharding error instead of the ValueError the default
+        # ``_place`` path raises.
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            shard_factor = 1
+            for name in names:
+                if name not in mesh.shape:
+                    raise ValueError(
+                        f"spec axis {name!r} not in mesh axes "
+                        f"{sorted(mesh.shape)}"
+                    )
+                shard_factor *= mesh.shape[name]
+            shard_factor = _local_slice(shard_factor)
+            if dim >= np.ndim(x) or np.shape(x)[dim] % shard_factor:
+                dim_size = np.shape(x)[dim] if dim < np.ndim(x) else "absent"
+                raise ValueError(
+                    f"dim {dim} (size {dim_size}) not divisible by the "
+                    f"local slice ({shard_factor}) of mesh axes {names}"
+                )
         sharding = NamedSharding(mesh, spec)
         if jax.process_count() > 1:
             # Same contract as the default path: each process passes its
@@ -121,12 +152,7 @@ def shard_batch_to_mesh(batch, mesh: Mesh, axis: str = "data", specs=None):
     def _place(x):
         if np.ndim(x) == 0:
             return jax.device_put(x, NamedSharding(mesh, P()))
-        # Each process contributes its local rows; the divisibility that
-        # matters is against the *local* slice of the axis (global size in
-        # single-process runs).
-        local_axis = mesh.shape[axis]
-        if jax.process_count() > 1 and local_axis % jax.process_count() == 0:
-            local_axis //= jax.process_count()
+        local_axis = _local_slice(mesh.shape[axis])
         if np.shape(x)[0] % local_axis:
             raise ValueError(
                 f"leading (batch) dim {np.shape(x)[0]} not divisible by the "
