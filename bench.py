@@ -556,9 +556,32 @@ def child_train() -> None:
         # Peak across the WHOLE sweep — including any failed/OOM'd batch
         # attempts — hence the explicit _sweep suffix; it bounds HBM for
         # the largest configuration tried, not the best batch alone.
+        # Captured BEFORE the unfused comparison run so that model's
+        # (larger) footprint cannot contaminate the fused sweep's bound.
         peak = _peak_device_memory(jax)
         if peak is not None:
             result["peak_device_memory_bytes_sweep"] = peak
+
+        # The sweep runs the fused-BN model (the default); one unfused
+        # point at the winning batch documents the fused-VJP byte cut as
+        # a measured on-chip speedup, not just a cost-analysis claim.
+        if on_accel:
+            try:
+                unfused_task = build_resnet_task(
+                    num_classes=1000, on_accel=on_accel, fused_bn=False
+                )
+                _, unfused_ips, _ = _bench_compute_at(
+                    jax, unfused_task, best_batch, image, steps
+                )
+                result["unfused"] = {
+                    "batch": best_batch,
+                    "images_per_sec": round(unfused_ips, 2),
+                    "fused_speedup": round(ips / unfused_ips, 4),
+                }
+            except Exception as e:
+                result["unfused"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]
+                }
 
         with tempfile.TemporaryDirectory() as tmpdir:
             # -- profiler: top device-time categories -----------------------

@@ -335,6 +335,13 @@ def register_train(sub: argparse._SubParsersAction) -> None:
         "default: True with --pretrained, else the value persisted in "
         "the checkpoint dir, else False",
     )
+    tr.add_argument(
+        "--fused-bn", action=argparse.BooleanOptionalAction, default=True,
+        help="fused BN+relu(+residual) with a minimal-residual custom "
+        "VJP (ops/fused_norm.py): same math and parameter tree, ~30%% "
+        "fewer HBM bytes per step — the v5e throughput lever. "
+        "--no-fused-bn falls back to flax BatchNorm",
+    )
     tr.add_argument("--workers", type=int, default=2)
     tr.add_argument("--queue-size", type=int, default=20)
     tr.add_argument(
@@ -428,11 +435,13 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     "model": args.model,
                     "num_classes": args.num_classes,
                     "crop": args.crop,
+                    "fused_bn": args.fused_bn,
                 }
             )
         )
     model = _build_classifier_model(
-        args.model, num_classes=args.num_classes, torch_padding=torch_padding
+        args.model, num_classes=args.num_classes, torch_padding=torch_padding,
+        fused_bn=args.fused_bn,
     )
     task = ClassifierTask(model=model, tx=optax.adam(args.learning_rate))
 
@@ -537,18 +546,19 @@ def _has_checkpoint(args: argparse.Namespace) -> bool:
 # --------------------------------------------------------------------------
 
 def _build_classifier_model(name: str, *, num_classes: int,
-                            torch_padding: bool):
+                            torch_padding: bool, fused_bn: bool = True):
     """The train/predict-shared model factory ("resnet50" | "tiny")."""
     from ..models import ResNet50
 
     if name == "resnet50":
-        return ResNet50(num_classes=num_classes, torch_padding=torch_padding)
+        return ResNet50(num_classes=num_classes, torch_padding=torch_padding,
+                        fused_bn=fused_bn)
     from ..models.resnet import ResNet, ResNetBlock
 
     return ResNet(
         stage_sizes=[1, 1], block_cls=ResNetBlock,
         num_classes=num_classes, num_filters=8,
-        torch_padding=torch_padding,
+        torch_padding=torch_padding, fused_bn=fused_bn,
     )
 
 
@@ -601,6 +611,9 @@ def _cmd_predict(args: argparse.Namespace) -> int:
         meta.get("model", "resnet50"),
         num_classes=int(meta["num_classes"]),
         torch_padding=bool(meta.get("torch_padding", False)),
+        # Eval-mode math is identical either way; rebuild what was
+        # trained for fidelity (older checkpoints predate the flag).
+        fused_bn=bool(meta.get("fused_bn", False)),
     )
     task = ClassifierTask(model=model)
 

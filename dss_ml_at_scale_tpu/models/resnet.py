@@ -24,6 +24,18 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+def _norm_relu(norm, act, fused, y, **kw):
+    """norm-then-relu, fused into one op when the fused path is on.
+
+    The single site encoding the fused-vs-unfused activation decision —
+    the stem and both block classes all route through it, so the two
+    configurations cannot drift apart.
+    """
+    if fused:
+        return norm(act="relu", **kw)(y)
+    return act(norm(**kw)(y))
+
+
 class BottleneckBlock(nn.Module):
     """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut."""
 
@@ -32,26 +44,30 @@ class BottleneckBlock(nn.Module):
     conv: ModuleDef
     norm: ModuleDef
     act: Callable = nn.relu
+    # Fused path: relu (and the final residual add) execute INSIDE the
+    # norm (ops/fused_norm.py) so backward saves no extra activations.
+    fused: bool = False
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (1, 1))(x)
-        y = self.norm()(y)
-        y = self.act(y)
+        y = _norm_relu(self.norm, self.act, self.fused, y)
         y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
-        y = self.norm()(y)
-        y = self.act(y)
+        y = _norm_relu(self.norm, self.act, self.fused, y)
         y = self.conv(self.filters * 4, (1, 1))(y)
-        # Zero-init the last BN scale so each block starts as identity —
-        # standard ResNet-v1.5 training recipe.
-        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
-        if residual.shape != y.shape:
+        if residual.shape[-1] != self.filters * 4 or self.strides != 1:
             residual = self.conv(
                 self.filters * 4, (1, 1), (self.strides, self.strides),
                 name="conv_proj",
             )(residual)
             residual = self.norm(name="norm_proj")(residual)
+        # Zero-init the last BN scale so each block starts as identity —
+        # standard ResNet-v1.5 training recipe.
+        if self.fused:
+            return self.norm(scale_init=nn.initializers.zeros_init(),
+                             act="relu")(y, residual=residual)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         return self.act(residual + y)
 
 
@@ -63,20 +79,23 @@ class ResNetBlock(nn.Module):
     conv: ModuleDef
     norm: ModuleDef
     act: Callable = nn.relu
+    fused: bool = False
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
-        y = self.norm()(y)
-        y = self.act(y)
+        y = _norm_relu(self.norm, self.act, self.fused, y)
         y = self.conv(self.filters, (3, 3))(y)
-        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
-        if residual.shape != y.shape:
+        if residual.shape[-1] != self.filters or self.strides != 1:
             residual = self.conv(
                 self.filters, (1, 1), (self.strides, self.strides), name="conv_proj"
             )(residual)
             residual = self.norm(name="norm_proj")(residual)
+        if self.fused:
+            return self.norm(scale_init=nn.initializers.zeros_init(),
+                             act="relu")(y, residual=residual)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         return self.act(residual + y)
 
 
@@ -94,6 +113,11 @@ class ResNet(nn.Module):
     # training from scratch; REQUIRED for numerical parity when loading
     # torchvision-layout pretrained weights (models/pretrained.py).
     torch_padding: bool = False
+    # Fused BN+relu(+residual) with a minimal-residual custom VJP
+    # (ops/fused_norm.py) — cuts the HBM bytes that cap v5e throughput
+    # (BASELINE.md). Parameter paths are IDENTICAL to the unfused model,
+    # so checkpoints and pretrained weights port both ways.
+    fused_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -108,8 +132,16 @@ class ResNet(nn.Module):
             conv = functools.partial(
                 nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
             )
+        if self.fused_bn:
+            if self.act is not nn.relu:
+                raise ValueError("fused_bn supports act=nn.relu only")
+            from ..ops.fused_norm import BatchNorm as FusedBatchNorm
+
+            norm_cls = FusedBatchNorm
+        else:
+            norm_cls = nn.BatchNorm
         norm = functools.partial(
-            nn.BatchNorm,
+            norm_cls,
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,
@@ -117,8 +149,7 @@ class ResNet(nn.Module):
         )
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
-        x = norm(name="norm_init")(x)
-        x = self.act(x)
+        x = _norm_relu(norm, self.act, self.fused_bn, x, name="norm_init")
         x = nn.max_pool(
             x, (3, 3), strides=(2, 2),
             padding=((1, 1), (1, 1)) if self.torch_padding else "SAME",
@@ -132,6 +163,7 @@ class ResNet(nn.Module):
                     conv=conv,
                     norm=norm,
                     act=self.act,
+                    fused=self.fused_bn,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -12,17 +12,26 @@ import time
 
 
 def build_resnet_task(num_classes: int, on_accel: bool,
-                      learning_rate: float = 1e-5):
+                      learning_rate: float = 1e-5, fused_bn: bool = True):
     """Benchmark ResNet-50: full-size bf16 on accelerators, a small f32
-    stand-in on CPU (where the number is a harness check, not a result)."""
+    stand-in on CPU (where the number is a harness check, not a result).
+
+    ``fused_bn`` (default on) selects the minimal-residual fused
+    BN+relu(+residual) path (ops/fused_norm.py) — the HBM byte cut that
+    BASELINE.md identifies as the throughput lever on v5e."""
     import jax.numpy as jnp
     import optax
 
     from ..models import ResNet50
     from ..parallel import ClassifierTask
 
-    model = ResNet50(num_classes=num_classes) if on_accel else ResNet50(
-        num_classes=num_classes, num_filters=8, dtype=jnp.float32
+    model = (
+        ResNet50(num_classes=num_classes, fused_bn=fused_bn)
+        if on_accel
+        else ResNet50(
+            num_classes=num_classes, num_filters=8, dtype=jnp.float32,
+            fused_bn=fused_bn,
+        )
     )
     return ClassifierTask(model=model, tx=optax.adam(learning_rate))
 
