@@ -66,6 +66,12 @@ def register_datagen(sub: argparse._SubParsersAction) -> None:
     img.add_argument("--classes", type=int, default=10)
     img.add_argument("--size", type=int, default=64)
     img.add_argument("--seed", type=int, default=0)
+    img.add_argument(
+        "--label-noise", type=float, default=0.0,
+        help="fraction of stored labels replaced by uniform draws; caps "
+        "best achievable accuracy at exactly (1-p)+p/classes, making "
+        "accuracy curves regression-discriminating",
+    )
     img.set_defaults(fn=_cmd_datagen_images)
 
 
@@ -126,11 +132,12 @@ def _cmd_datagen_images(args: argparse.Namespace) -> int:
 
     labels = write_image_delta(
         args.out, args.n, classes=args.classes, size=args.size,
-        seed=args.seed, mode="overwrite",
+        seed=args.seed, label_noise=args.label_noise, mode="overwrite",
     )
+    noise = f", label noise {args.label_noise}" if args.label_noise else ""
     print(
         f"images: {len(labels)} JPEGs, {args.classes} classes, "
-        f"{args.size}px -> {args.out}"
+        f"{args.size}px{noise} -> {args.out}"
     )
     return 0
 
@@ -152,8 +159,7 @@ def register_forecast(sub: argparse._SubParsersAction) -> None:
         "--no-mesh", action="store_true",
         help="keep the group axis on one device (debug)",
     )
-    fc.add_argument("--experiment", default="forecasting")
-    fc.add_argument("--tracking-root", default=None)
+    _add_tracking_args(fc, "forecasting")
     fc.add_argument("--max-p", type=int, default=4, help="AR order bound")
     fc.add_argument("--max-d", type=int, default=2, help="differencing bound")
     fc.add_argument("--max-q", type=int, default=4, help="MA order bound")
@@ -196,15 +202,12 @@ def _cmd_forecast(args: argparse.Namespace) -> int:
     err = out["Demand"] - out["Demand_Fitted"]
     mse = float((err**2).mean())
     groups = out.groupby(["Product", "SKU"]).ngroups
-    if args.tracking_root:
-        from ..tracking import RunStore
-
-        store = RunStore(args.tracking_root, args.experiment, run_name="forecast")
-        store.log_params(
-            {"max_evals": args.max_evals, "horizon": args.horizon, "groups": groups}
-        )
-        store.log_metrics({"mse": mse, "wall_s": dt}, step=0)
-        store.finish()
+    _finish_tracker(
+        _open_tracker(args, "forecast"),
+        params={"max_evals": args.max_evals, "horizon": args.horizon,
+                "groups": groups},
+        metrics={"mse": mse, "wall_s": dt}, step=0,
+    )
     print(
         f"forecast: {groups} groups, {len(out)} rows, mse {mse:.2f}, "
         f"{dt:.1f}s -> {args.out}"
@@ -238,6 +241,7 @@ def register_eda(sub: argparse._SubParsersAction) -> None:
         help="write the reference-style comparison figure (actual series "
         "+ top models' holdout predictions) to this PNG",
     )
+    _add_tracking_args(eda, "eda")
     eda.set_defaults(fn=_cmd_eda)
 
 
@@ -247,6 +251,7 @@ def _cmd_eda(args: argparse.Namespace) -> int:
     from ..workloads.forecasting import EXO_FIELDS
 
     df = _read_delta_pandas(args.data)
+    tracker = _open_tracker(args, "eda")
     report = run_eda(
         df,
         product=args.product,
@@ -258,11 +263,19 @@ def _cmd_eda(args: argparse.Namespace) -> int:
         cfg=SarimaxConfig(k_exog=len(EXO_FIELDS), max_iter=args.max_iter),
         polish=args.polish,
         return_curves=args.plot is not None,
+        tracker=tracker,
     )
     print(f"EDA for Product={report.product} SKU={report.sku} "
           f"(holdout {args.horizon} weeks)")
     print(report.scores.to_string(index=False))
     print(f"best SARIMAX order: {report.best_order} (mse {report.best_order_mse:.2f})")
+    _finish_tracker(
+        tracker,
+        params={"product": report.product, "sku": report.sku,
+                "max_evals": args.max_evals, "horizon": args.horizon},
+        metrics={"best_order_mse": report.best_order_mse},
+        step=args.max_evals,
+    )
     if args.plot:
         report.plot(args.plot)
         print(f"comparison figure -> {args.plot}")
@@ -865,6 +878,7 @@ def register_hpo(sub: argparse._SubParsersAction) -> None:
         help="file holding the shared RPC secret (or env DSST_RPC_SECRET); "
         "enables the HMAC handshake with the workers",
     )
+    _add_tracking_args(hp_, "hpo")
     hp_.set_defaults(fn=_cmd_hpo)
 
 
@@ -920,10 +934,13 @@ def _cmd_hpo(args: argparse.Namespace) -> int:
 
     if args.workers:
         # Remote mode: objective ships by module reference, data by
-        # shared FS — the multi-host SparkTrials shape.
+        # shared FS — the multi-host SparkTrials shape. Validate BEFORE
+        # opening a tracker: a usage error must not litter an orphaned
+        # RUNNING run.
         if not args.data:
             print("--workers requires --data (shared-FS npz every worker can read)")
             return 2
+        tracker = _open_tracker(args, "hpo")
         import numpy as np
 
         from ..hpo import fmin, hp
@@ -944,14 +961,19 @@ def _cmd_hpo(args: argparse.Namespace) -> int:
             max_evals=args.max_evals,
             trials=trials,
             rstate=np.random.default_rng(0),
+            tracker=tracker,
         )
         ok = sum(1 for t in trials.trials if t["result"]["status"] == "ok")
+        _finish_tracker(
+            tracker, params={"mode": "remote", "workers": args.workers}
+        )
         print(
             f"hpo (remote, {len(trials.workers)} workers): best alpha "
             f"{best['alpha']:.4f} ({ok}/{len(trials.trials)} trials ok)"
         )
         return 0
 
+    tracker = _open_tracker(args, "hpo")
     if args.data:
         arrays = load_shared(args.data)
         data = (
@@ -967,8 +989,10 @@ def _cmd_hpo(args: argparse.Namespace) -> int:
         return train_and_eval(data, alpha)
 
     best = tune_alpha(
-        objective, parallelism=args.parallelism, max_evals=args.max_evals
+        objective, parallelism=args.parallelism, max_evals=args.max_evals,
+        tracker=tracker,
     )
+    _finish_tracker(tracker, params={"mode": mode, "best_alpha": best})
     print(f"hpo ({mode}): best alpha {best:.4f}")
     return 0
 
@@ -976,6 +1000,57 @@ def _cmd_hpo(args: argparse.Namespace) -> int:
 # --------------------------------------------------------------------------
 # shared helpers
 # --------------------------------------------------------------------------
+
+DEFAULT_TRACKING_ROOT = "dsst_runs"
+
+
+def _add_tracking_args(parser, experiment: str) -> None:
+    """Tracking flags with autologging ON by default.
+
+    The reference logs every SparkTrials trial under an active MLflow run
+    with zero user code (``hyperopt/1. hyperopt.py:130-136``); the
+    equivalent default here is a RunStore under ./dsst_runs unless
+    --no-tracking (or --tracking-root '') opts out. The env var
+    DSST_TRACKING_ROOT overrides the default root (read per invocation,
+    so wrappers and test harnesses can redirect every run — including
+    subprocess pipelines — without threading a flag through)."""
+    parser.add_argument("--experiment", default=experiment)
+    root = os.environ.get("DSST_TRACKING_ROOT", DEFAULT_TRACKING_ROOT)
+    parser.add_argument(
+        "--tracking-root", default=root,
+        help=f"run-store root (default ./{DEFAULT_TRACKING_ROOT}, or env "
+        "DSST_TRACKING_ROOT)",
+    )
+    parser.add_argument(
+        "--no-tracking", action="store_true",
+        help="disable the default run/trial autologging",
+    )
+
+
+def _open_tracker(args: argparse.Namespace, run_name: str):
+    """RunStore for a CLI run, or None when tracking is opted out."""
+    if getattr(args, "no_tracking", False) or not getattr(
+        args, "tracking_root", None
+    ):
+        return None
+    from ..tracking import RunStore
+
+    return RunStore(args.tracking_root, args.experiment, run_name=run_name)
+
+
+def _finish_tracker(tracker, params: dict | None = None,
+                    metrics: dict | None = None, step: int | None = None):
+    """The one place a CLI run is closed: final params/metrics, FINISHED
+    status, and the 'run ->' pointer the user needs to find it."""
+    if tracker is None:
+        return
+    if params:
+        tracker.log_params(params)
+    if metrics:
+        tracker.log_metrics(metrics, step=step)
+    tracker.finish()
+    print(f"run -> {tracker.path}")
+
 
 def _read_delta_pandas(path: str, columns: list[str] | None = None):
     """Whole-table read through the Delta log (no Spark; reference reads

@@ -53,13 +53,23 @@ def write_image_delta(
     classes: int = 10,
     size: int = 64,
     seed: int = 0,
+    label_noise: float = 0.0,
     max_rows_per_file: int = 256,
     mode: str = "error",
 ):
     """Generate ``n`` labeled JPEGs into a Delta table (content/label_index).
 
-    Returns the label array (generation order; the table's canonical read
-    order depends on fragment naming — join through the table, not this).
+    ``label_noise``: fraction of rows whose STORED label is replaced by a
+    uniform draw over all classes (the image itself is always rendered
+    from the true class). With rate ρ on a split, the best achievable
+    accuracy against its stored labels is exactly ``(1-ρ) + ρ/classes``
+    — a known ceiling strictly below 1, which makes accuracy curves
+    discriminating: a regression moves the plateau out of a pinned band,
+    where a saturating clean run (val_acc 1.0) hides it.
+
+    Returns the stored label array (generation order; the table's
+    canonical read order depends on fragment naming — join through the
+    table, not this).
     """
     import pyarrow as pa
 
@@ -67,14 +77,18 @@ def write_image_delta(
 
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, classes, n)
+    jpegs = [grating_jpeg(rng, int(l), classes, size) for l in labels]
+    stored = labels.copy()
+    if label_noise:
+        # Noise draws come AFTER the image draws so the same seed yields
+        # byte-identical images at any noise rate.
+        flip = rng.random(n) < label_noise
+        stored[flip] = rng.integers(0, classes, int(flip.sum()))
     table = pa.table(
         {
-            "content": pa.array(
-                [grating_jpeg(rng, int(l), classes, size) for l in labels],
-                type=pa.binary(),
-            ),
-            "label_index": pa.array(labels.astype(np.int64)),
+            "content": pa.array(jpegs, type=pa.binary()),
+            "label_index": pa.array(stored.astype(np.int64)),
         }
     )
     write_delta(table, path, max_rows_per_file=max_rows_per_file, mode=mode)
-    return labels
+    return stored

@@ -44,7 +44,8 @@ def train_and_eval(data, alpha: float) -> dict:
     return {"loss": loss, "status": "ok"}
 
 
-def tune_alpha(objective, parallelism: int = 2, max_evals: int = 4) -> float:
+def tune_alpha(objective, parallelism: int = 2, max_evals: int = 4,
+               tracker=None) -> float:
     """4-eval TPE sweep over alpha on the parallel executor (``:45-56``)."""
     from ..hpo import fmin, hp
     from ..parallel import DeviceTrials
@@ -55,5 +56,6 @@ def tune_alpha(objective, parallelism: int = 2, max_evals: int = 4) -> float:
         max_evals=max_evals,
         trials=DeviceTrials(parallelism=parallelism),
         rstate=np.random.default_rng(0),
+        tracker=tracker,
     )
     return best["alpha"]

@@ -138,8 +138,13 @@ def run_eda(
     cfg: SarimaxConfig | None = None,
     polish: bool = False,
     return_curves: bool = False,
+    tracker=None,
 ) -> EdaReport:
     """Fit every candidate model on one SKU and score the holdout window.
+
+    ``tracker`` (a :class:`~dss_ml_at_scale_tpu.tracking.RunStore`) logs
+    every TPE trial as it completes — the SparkTrials-under-MLflow
+    autologging shape (reference ``hyperopt/1. hyperopt.py:130-136``).
 
     ``polish=True`` refines the ranked SARIMAX fits with the host-side
     float64 Nelder-Mead polish (:func:`~dss_ml_at_scale_tpu.ops.
@@ -227,7 +232,7 @@ def run_eda(
     trials = DeviceTrials(parallelism=parallelism, pin_devices=False)
     best = fmin(
         objective, space, max_evals=max_evals, trials=trials,
-        rstate=np.random.default_rng(rstate),
+        rstate=np.random.default_rng(rstate), tracker=tracker,
     )
     best_order = (int(best["p"]), int(best["d"]), int(best["q"]))
     best_mse = float(trials.best_trial["result"]["loss"])

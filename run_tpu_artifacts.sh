@@ -28,6 +28,6 @@ echo
 
 if [ $PROBE_RC -eq 0 ]; then
   echo "== accuracy proof on chip =="
-  timeout 1800 python bench_accuracy.py --out ACCURACY_r03.json
+  timeout 1800 python bench_accuracy.py --out ACCURACY_r04.json
   echo "accuracy rc=$?"
 fi

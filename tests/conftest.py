@@ -34,6 +34,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _isolated_tracking_root(tmp_path, monkeypatch):
+    """CLI autologging defaults ON (dsst_runs/ in cwd); redirect every
+    test's default root — including subprocess pipelines, which inherit
+    the env — under tmp_path so suite runs never litter the repo.
+    Tests that pass an explicit --tracking-root are unaffected."""
+    monkeypatch.setenv("DSST_TRACKING_ROOT", str(tmp_path / "dsst_runs"))
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

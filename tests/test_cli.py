@@ -47,9 +47,31 @@ def test_datagen_regression_and_hpo_shared_fs(tmp_path, capsys):
     assert "shared-fs" in capsys.readouterr().out
 
 
-def test_hpo_closure_mode(capsys):
+def test_hpo_closure_mode(tmp_path, monkeypatch, capsys):
+    # Default autologging: with no tracking flags at all, every trial
+    # must land in ./dsst_runs (the SparkTrials-under-MLflow default,
+    # reference hyperopt/1. hyperopt.py:130-136).
+    monkeypatch.chdir(tmp_path)
     assert main(["hpo", "--bytes", "100000", "--max-evals", "2"]) == 0
     assert "closure" in capsys.readouterr().out
+    runs = list((tmp_path / "dsst_runs" / "hpo").iterdir())
+    assert len(runs) == 1
+    params = json.loads((runs[0] / "params.json").read_text())
+    assert "trial_0" in params and "trial_1" in params
+    metrics = [
+        json.loads(line)
+        for line in (runs[0] / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert sum(1 for m in metrics if m["name"] == "loss") >= 2
+
+
+def test_hpo_no_tracking_opt_out(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    assert main([
+        "hpo", "--bytes", "100000", "--max-evals", "2", "--no-tracking",
+    ]) == 0
+    capsys.readouterr()
+    assert not (tmp_path / "dsst_runs").exists()
 
 
 @pytest.mark.slow
@@ -243,7 +265,8 @@ def test_pipeline_summary_separates_failed_from_skipped(tmp_path, capsys):
 
 
 @pytest.mark.slow
-def test_eda_cli(tmp_path, capsys, devices8):
+def test_eda_cli(tmp_path, monkeypatch, capsys, devices8):
+    monkeypatch.chdir(tmp_path)
     demand = tmp_path / "demand"
     main([
         "datagen", "demand", "--out", str(demand), "--skus-per-product", "1",
@@ -256,6 +279,11 @@ def test_eda_cli(tmp_path, capsys, devices8):
     out = capsys.readouterr().out
     assert "hw_add" in out and "sarimax_exog" in out
     assert "best SARIMAX order" in out
+    # TPE trials autolog by default, one metrics line per trial.
+    runs = list((tmp_path / "dsst_runs" / "eda").iterdir())
+    assert len(runs) == 1
+    params = json.loads((runs[0] / "params.json").read_text())
+    assert "trial_0" in params and "sku" in params
 
 
 def test_ingest_cli(tmp_path, capsys):
@@ -428,6 +456,27 @@ def test_datagen_images(tmp_path, capsys):
     assert len(df) == 32
     assert set(df["label_index"]) <= {0, 1, 2, 3}
     assert "32 JPEGs" in capsys.readouterr().out
+
+
+def test_datagen_images_label_noise(tmp_path):
+    # Same seed, with and without noise: images identical, a fraction of
+    # stored labels flipped — the pinned-accuracy-ceiling regime of
+    # bench_accuracy.py (ceiling = (1-p) + p/classes).
+    from dss_ml_at_scale_tpu.config.commands import _read_delta_pandas
+
+    clean, noisy = tmp_path / "clean", tmp_path / "noisy"
+    assert main(["datagen", "images", "--out", str(clean), "--n", "256",
+                 "--classes", "4", "--size", "16"]) == 0
+    assert main(["datagen", "images", "--out", str(noisy), "--n", "256",
+                 "--classes", "4", "--size", "16",
+                 "--label-noise", "0.5"]) == 0
+    df_c = _read_delta_pandas(clean).sort_values("content", ignore_index=True)
+    df_n = _read_delta_pandas(noisy).sort_values("content", ignore_index=True)
+    # Images come from the TRUE labels — byte-identical across runs.
+    assert (df_c["content"] == df_n["content"]).all()
+    flipped = (df_c["label_index"] != df_n["label_index"]).mean()
+    # p=0.5 with uniform redraw over 4 classes changes ~0.5*3/4 = 0.375.
+    assert 0.25 < flipped < 0.5
 
 
 @pytest.mark.slow

@@ -253,6 +253,37 @@ def test_tune_and_forecast_panel(rng):
 
 
 @pytest.mark.slow
+def test_tune_and_forecast_panel_hundreds_of_groups(rng, devices8):
+    # Reference scale contract ("thousands of SKUs", group_apply/02...py:
+    # 516-528): G in the hundreds through the sharded vmapped tuner on the
+    # simulated mesh. Correctness anchor: with a scalar rstate every group
+    # runs an identical, independent TPE stream (reference seeds every SKU
+    # with rstate=123), so any SKU re-tuned alone must reproduce its
+    # panel-run fit exactly — batch size and mesh placement cannot leak
+    # into a group's result.
+    G, weeks, horizon = 200, 32, 8
+    mesh = make_mesh({"data": 8})
+    cfg = SarimaxConfig(max_p=1, max_d=1, max_q=1, k_exog=3, max_iter=30)
+    df = add_exo_variables(_demand_frame(rng, n_sku=G, weeks=weeks))
+    kwargs = dict(max_evals=2, forecast_horizon=horizon, cfg=cfg, rstate=123)
+    out = tune_and_forecast_panel(df, mesh=mesh, **kwargs)
+    assert len(out) == len(df)
+    assert out["SKU"].nunique() == G
+    assert np.isfinite(out["Demand_Fitted"]).all()
+
+    pick = ["SKU0", "SKU57", "SKU199"]
+    sub = df[df["SKU"].isin(pick)].reset_index(drop=True)
+    sub_out = tune_and_forecast_panel(sub, **kwargs)
+    merged = out[out["SKU"].isin(pick)].reset_index(drop=True)
+    for sku in pick:
+        np.testing.assert_allclose(
+            merged[merged["SKU"] == sku]["Demand_Fitted"].to_numpy(),
+            sub_out[sub_out["SKU"] == sku]["Demand_Fitted"].to_numpy(),
+            rtol=1e-4, atol=1e-3, err_msg=sku,
+        )
+
+
+@pytest.mark.slow
 def test_tune_and_forecast_panel_mesh_matches_unsharded(rng, devices8):
     # The flagship group-parallel claim (reference contract
     # group_apply/02...py:516-528, one task per group): G >> n_devices
