@@ -74,6 +74,17 @@ def register_datagen(sub: argparse._SubParsersAction) -> None:
     )
     img.set_defaults(fn=_cmd_datagen_images)
 
+    ph = gsub.add_parser(
+        "photos",
+        help="real-photograph JPEG crops (sklearn's CC-BY sample photos) "
+        "as an ImageNet-style file tree for dsst ingest",
+    )
+    ph.add_argument("--out", required=True, help="tree root (files go in Data/)")
+    ph.add_argument("--n", type=int, default=192)
+    ph.add_argument("--size", type=int, default=96)
+    ph.add_argument("--seed", type=int, default=0)
+    ph.set_defaults(fn=_cmd_datagen_photos)
+
 
 def _cmd_datagen_demand(args: argparse.Namespace) -> int:
     # The ARMA sampler runs through JAX; for a datagen-sized workload the
@@ -138,6 +149,17 @@ def _cmd_datagen_images(args: argparse.Namespace) -> int:
     print(
         f"images: {len(labels)} JPEGs, {args.classes} classes, "
         f"{args.size}px{noise} -> {args.out}"
+    )
+    return 0
+
+
+def _cmd_datagen_photos(args: argparse.Namespace) -> int:
+    from ..datagen.photos import CLASSES, write_photo_tree
+
+    n = write_photo_tree(args.out, args.n, size=args.size, seed=args.seed)
+    print(
+        f"photos: {n} real-photo JPEG crops, {len(CLASSES)} classes, "
+        f"{args.size}px -> {args.out}"
     )
     return 0
 
@@ -298,6 +320,11 @@ def register_ingest(sub: argparse._SubParsersAction) -> None:
     )
     ing.add_argument("--rows-per-fragment", type=int, default=1024)
     ing.add_argument("--append", action="store_true")
+    ing.add_argument(
+        "--allow-unlabeled", action="store_true",
+        help="ingest rows with no determinable label as label_index=-1 "
+        "instead of failing (filter them before training)",
+    )
     ing.set_defaults(fn=_cmd_ingest)
 
 
@@ -311,6 +338,7 @@ def _cmd_ingest(args: argparse.Namespace) -> int:
         label_from=args.label_from,
         rows_per_fragment=args.rows_per_fragment,
         mode="append" if args.append else "overwrite",
+        on_missing_label="keep" if args.allow_unlabeled else "error",
     )
     print(f"ingested {table.num_records()} rows -> {args.out}")
     return 0

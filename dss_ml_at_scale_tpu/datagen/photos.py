@@ -1,0 +1,76 @@
+"""Real-photograph fixture sets: crops of actual camera images → JPEG tree.
+
+The reference's deep-learning track trains on real ImageNet JPEGs
+(``deep_learning/1.data-preparation.py:26-32,118-124``). This
+environment has no network, so the real photographic bytes come from the
+two sample photographs scikit-learn ships in its wheel (china.jpg and
+flower.jpg, CC-BY 2.0, attribution in
+``sklearn/datasets/images/README.txt``). Random crops of them carry what
+synthetic gratings cannot: real sensor noise, natural textures and
+lighting, and genuine JPEG artifacts — so the decode → augment → train
+path is exercised on honest camera data, labeled by source photograph.
+
+The output is an ImageNet-style file tree (``Data/<class>_<i>.JPEG``,
+label parsed from the filename prefix) so it flows through ``dsst
+ingest`` exactly like the reference's tree layout.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+CLASSES = ("china", "flower")
+
+
+def _source_photos() -> dict[str, np.ndarray]:
+    from sklearn.datasets import load_sample_image
+
+    return {name: np.asarray(load_sample_image(f"{name}.jpg"))
+            for name in CLASSES}
+
+
+def write_photo_tree(
+    out_root: str | Path,
+    n: int,
+    *,
+    size: int = 96,
+    seed: int = 0,
+    quality: int = 92,
+    data_dir: str = "Data",
+) -> int:
+    """Write ``n`` labeled real-photo JPEG crops under ``out_root/Data``.
+
+    Classes alternate between the two source photographs; each file is a
+    uniformly-placed ``size``×``size`` crop, horizontally flipped half
+    the time. Deterministic for a given seed. Returns the file count.
+    """
+    from PIL import Image
+
+    sources = _source_photos()
+    for name, arr in sources.items():
+        if min(arr.shape[:2]) <= size:
+            raise ValueError(
+                f"crop size {size} too large for source {name} {arr.shape}"
+            )
+    rng = np.random.default_rng(seed)
+    out = Path(out_root) / data_dir
+    out.mkdir(parents=True, exist_ok=True)
+    # Overwrite semantics (like the Delta generators): stale crops from a
+    # previous larger/differently-sized run must not leak into ingest.
+    for old in out.glob("*.JPEG"):
+        old.unlink()
+    for i in range(n):
+        name = CLASSES[i % len(CLASSES)]
+        arr = sources[name]
+        h, w = arr.shape[:2]
+        y = int(rng.integers(0, h - size))
+        x = int(rng.integers(0, w - size))
+        crop = arr[y:y + size, x:x + size]
+        if rng.random() < 0.5:
+            crop = crop[:, ::-1]
+        Image.fromarray(np.ascontiguousarray(crop)).save(
+            out / f"{name}_{i}.JPEG", format="JPEG", quality=quality
+        )
+    return n

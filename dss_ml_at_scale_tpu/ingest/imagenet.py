@@ -132,6 +132,7 @@ def ingest_image_dataset(
     data_dir: str = "Data",
     rows_per_fragment: int = 1024,
     mode: str = "overwrite",
+    on_missing_label: str = "error",
 ) -> DeltaTable:
     """Scan → annotate → label → write Delta with stable ``id`` column.
 
@@ -139,15 +140,38 @@ def ingest_image_dataset(
     all sit in memory; ids are contiguous across fragments (zipWithIndex
     semantics). ``label_from`` mirrors the reference's two splits: train
     labels parsed from filenames, val labels from XML annotations.
+
+    A row whose label cannot be determined (``label_from="annotation"``
+    with a missing or object-less XML) raises by default — a silent
+    sentinel would corrupt training loss downstream. Pass
+    ``on_missing_label="keep"`` to ingest it anyway with
+    ``label_index=-1`` (callers must then filter before training).
     """
     if label_from not in ("path", "annotation"):
         raise ValueError(f"label_from must be 'path' or 'annotation', got {label_from!r}")
+    if on_missing_label not in ("error", "keep"):
+        raise ValueError(
+            f"on_missing_label must be 'error' or 'keep', got {on_missing_label!r}"
+        )
 
     # Appending continues the id sequence from the existing table so ids
     # stay unique and monotonic (zipWithIndex semantics across ingests).
     id_start = 0
     if mode == "append" and Path(table_path, "_delta_log").exists():
         import pyarrow.parquet as pq
+
+        # Tables ingested before label_index existed lack the column in
+        # their fragments; mixing schemas would break every whole-table
+        # read mid-epoch instead of failing here with a way out.
+        first_uri = next(iter(DeltaTable(table_path).file_uris()), None)
+        if first_uri is not None and "label_index" not in set(
+            pq.ParquetFile(first_uri).schema_arrow.names
+        ):
+            raise ValueError(
+                f"{table_path} was ingested by an older version without "
+                "the label_index column; re-ingest it (mode='overwrite') "
+                "before appending"
+            )
 
         for uri in DeltaTable(table_path).file_uris():
             # Footer statistics only — no data pages read.
@@ -163,6 +187,18 @@ def ingest_image_dataset(
                         id_start = max(id_start, ids.to_numpy().max() + 1)
                     break
 
+    # object_id → label_index assigned on first encounter. The scan is
+    # sorted (scan_binary_files rglob-sorts), so for an ImageNet-style
+    # tree this is sorted-wnid order and deterministic across re-ingests
+    # of the same tree; the vocabulary is persisted as labels.json next
+    # to the table so train/predict (which consume the int label_index
+    # column directly) can map predictions back to names.
+    vocab: dict[str, int] = {}
+    if mode == "append":
+        labels_path = Path(table_path) / "labels.json"
+        if labels_path.exists():
+            vocab = json.loads(labels_path.read_text())
+
     def rows() -> Iterator[dict]:
         for i, rec in enumerate(scan_binary_files(data_root, file_pattern), start=id_start):
             ann = xml_annotation_to_json(rec["path"], data_dir, annotations_dir)
@@ -172,6 +208,19 @@ def ingest_image_dataset(
                 if label_from == "path"
                 else extract_object(ann)
             )
+            if rec["object_id"] is None:
+                if on_missing_label == "error":
+                    raise ValueError(
+                        f"no label for {rec['path']} (label_from="
+                        f"{label_from!r}); fix the annotation or pass "
+                        "on_missing_label='keep' to ingest it with "
+                        "label_index=-1"
+                    )
+                rec["label_index"] = -1
+            else:
+                rec["label_index"] = vocab.setdefault(
+                    rec["object_id"], len(vocab)
+                )
             rec["id"] = i
             yield rec
 
@@ -183,6 +232,7 @@ def ingest_image_dataset(
             ("content", pa.binary()),
             ("annotation", pa.string()),
             ("object_id", pa.string()),
+            ("label_index", pa.int64()),
             ("id", pa.int64()),
         ]
     )
@@ -202,4 +252,5 @@ def ingest_image_dataset(
             batch = []
     if batch or not written:
         flush(batch, not written)
+    (Path(table_path) / "labels.json").write_text(json.dumps(vocab))
     return DeltaTable(table_path)

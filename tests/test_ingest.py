@@ -101,6 +101,51 @@ def test_ingest_val_split_labels_from_annotation(image_tree, tmp_path):
     assert set(got["object_id"].to_pylist()) == {"n01440764", "n02007558"}
 
 
+def test_ingest_missing_label_raises_unless_kept(image_tree, tmp_path):
+    # An annotation-less image under label_from="annotation": silent -1
+    # would corrupt training loss downstream, so the default is an error;
+    # on_missing_label="keep" opts into the sentinel explicitly.
+    extra = image_tree / "Data" / "n01440764" / "n01440764_noann.JPEG"
+    extra.write_bytes(
+        (image_tree / "Data" / "n01440764" / "n01440764_0.JPEG").read_bytes()
+    )
+    try:
+        with pytest.raises(ValueError, match="no label for"):
+            ingest_image_dataset(
+                image_tree / "Data", tmp_path / "e.delta",
+                label_from="annotation",
+            )
+        table = ingest_image_dataset(
+            image_tree / "Data", tmp_path / "k.delta",
+            label_from="annotation", on_missing_label="keep",
+        )
+        import pyarrow.parquet as pq
+
+        got = pq.read_table(table.file_uris()[0])
+        by_path = dict(
+            zip(got["path"].to_pylist(), got["label_index"].to_pylist())
+        )
+        assert by_path[str(extra)] == -1
+        assert set(v for k, v in by_path.items() if k != str(extra)) == {0, 1}
+    finally:
+        extra.unlink()  # module-scoped fixture: leave it as found
+
+
+def test_ingest_append_rejects_pre_label_index_tables(image_tree, tmp_path):
+    # Fragments written before the label_index column existed must fail
+    # append-time, not mid-epoch with a mixed-schema read error.
+    table = ingest_image_dataset(image_tree / "Data", tmp_path / "old.delta")
+    import pyarrow.parquet as pq
+
+    for uri in table.file_uris():
+        t = pq.read_table(uri)
+        pq.write_table(t.drop_columns(["label_index"]), uri)
+    with pytest.raises(ValueError, match="older version"):
+        ingest_image_dataset(
+            image_tree / "Data", tmp_path / "old.delta", mode="append"
+        )
+
+
 def test_ingested_table_feeds_reader(image_tree, tmp_path):
     # The ingestion output must stream through the framework's own loader —
     # the train-path integration the reference achieves via Petastorm.
