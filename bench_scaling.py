@@ -23,31 +23,13 @@ import json
 
 def measure(task, n_devices: int, batch_per_device: int, image: int,
             steps: int) -> float:
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from dss_ml_at_scale_tpu.runtime import make_mesh
     from dss_ml_at_scale_tpu.utils.benchlib import (
-        synthetic_image_batch,
+        dp_sharded_step,
         timed_train_steps,
     )
 
-    mesh = make_mesh({"data": n_devices}, devices=jax.devices()[:n_devices])
-    batch = synthetic_image_batch(
-        batch_per_device * n_devices, image, num_classes=100
-    )
-    state = task.init_state(jax.random.key(0), batch)
-    state = jax.device_put(state, NamedSharding(mesh, P()))
-    batch = {
-        "image": jax.device_put(
-            batch["image"], NamedSharding(mesh, P("data", None, None, None))
-        ),
-        "label": jax.device_put(batch["label"], NamedSharding(mesh, P("data"))),
-    }
-    replicated = NamedSharding(mesh, P())
-    step_fn = jax.jit(
-        task.train_step, donate_argnums=0,
-        out_shardings=(replicated, replicated),
+    step_fn, state, batch = dp_sharded_step(
+        task, n_devices, batch_per_device, image, num_classes=100
     )
     _, dt = timed_train_steps(step_fn, state, batch, steps)
     return batch_per_device * n_devices * steps / dt

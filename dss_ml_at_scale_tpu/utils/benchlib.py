@@ -36,6 +36,39 @@ def build_resnet_task(num_classes: int, on_accel: bool,
     return ClassifierTask(model=model, tx=optax.adam(learning_rate))
 
 
+def dp_sharded_step(task, n_devices: int, batch_per_device: int, image: int,
+                    num_classes: int, donate: bool = True):
+    """(jitted step, placed state, placed batch) for a pure-DP mesh.
+
+    The one DP sharding scaffold shared by the throughput harness
+    (bench_scaling.py) and the collective-bytes model (scaling_model.py),
+    so the program they measure is the same program."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..runtime import make_mesh
+
+    mesh = make_mesh({"data": n_devices}, devices=jax.devices()[:n_devices])
+    batch = synthetic_image_batch(
+        batch_per_device * n_devices, image, num_classes=num_classes
+    )
+    state = task.init_state(jax.random.key(0), batch)
+    replicated = NamedSharding(mesh, P())
+    state = jax.device_put(state, replicated)
+    batch = {
+        "image": jax.device_put(
+            batch["image"], NamedSharding(mesh, P("data", None, None, None))
+        ),
+        "label": jax.device_put(batch["label"], NamedSharding(mesh, P("data"))),
+    }
+    step = jax.jit(
+        task.train_step,
+        donate_argnums=(0,) if donate else (),
+        out_shardings=(replicated, replicated),
+    )
+    return step, state, batch
+
+
 def synthetic_image_batch(batch: int, image: int, num_classes: int,
                           seed: int = 0) -> dict:
     import numpy as np

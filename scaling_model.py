@@ -1,0 +1,189 @@
+"""ICI roofline model: predicted DP scaling efficiency, 1 → 32 v5e chips.
+
+The north star (BASELINE.json) is ≥90% scaling efficiency at 32 chips.
+Real 1→32 hardware is unavailable in this rig, so this model predicts it
+from measured inputs instead of asserting it:
+
+1. **Per-step collective bytes — measured from the program.** The DP
+   train step is SPMD-compiled over a simulated 8-device mesh and every
+   ``all-reduce`` instruction in the optimized HLO is parsed for its
+   shape: gradient all-reduce (the f32 parameter gradients), the sync-BN
+   batch-stat reductions that run inside the forward/backward, and the
+   scalar metric reductions. This is exactly what XLA will emit on a
+   real slice — not a hand estimate of "params × 4 bytes".
+2. **Per-chip step time — measured on the chip.** The round-3 on-chip
+   sweep (BASELINE.md, TPU v5 lite): the table below, refreshable from a
+   ``BENCH_local*.json`` with ``platform: "tpu"`` when the tunnel is up.
+3. **ICI bandwidth — published.** TPU v5e exposes 1600 Gbit/s of ICI
+   per chip over 4 links (public v5e spec). A bidirectional ring
+   all-reduce occupies one link pair each way → 100 GB/s effective is
+   the primary assumption; 50 (single link, worst case) and 200
+   (all-links, multi-ring torus collectives) bound it.
+
+Ring all-reduce cost: each chip moves ``2·(N-1)/N · bytes`` at the
+effective bandwidth. Efficiency bounds per N:
+
+- no overlap (pessimistic):  t = t_compute + t_comm
+- full overlap (XLA overlaps the gradient all-reduce with remaining
+  backward compute; optimistic): t = max(t_compute, t_comm)
+
+All 32 chips sit inside one v5e pod (ICI reaches 256 chips), so no DCN
+hop enters the model. Writes SCALING_MODEL.json and prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+# Round-3 on-chip measurements (BASELINE.md "Where the ceiling is";
+# committed at b9e8bc7): per-chip images/sec by per-chip batch, bf16
+# NHWC ResNet-50 train step on TPU v5 lite behind the axon tunnel.
+MEASURED_ON_CHIP = {
+    "device": "TPU v5 lite",
+    "source": "BASELINE.md round-3 sweep (bench.py)",
+    "images_per_sec_by_batch": {212: 2334.0, 256: 2410.0, 384: 2429.0,
+                                512: 2354.0},
+}
+
+# Public v5e ICI spec: 4 links × 400 Gbit/s = 1600 Gbit/s per chip.
+ICI_EFFECTIVE_GBPS = {
+    "single_link_worst": 50.0e9,
+    "ring_link_pair_primary": 100.0e9,
+    "all_links_best": 200.0e9,
+}
+
+DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+               "f64": 8, "pred": 1, "s8": 1, "u8": 1}
+
+
+def measure_allreduce_bytes(n_devices: int = 8, batch_per_device: int = 2,
+                            image: int = 224, num_classes: int = 1000):
+    """Compile the DP train step SPMD and sum all-reduce bytes from HLO."""
+    import os
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from dss_ml_at_scale_tpu.utils.benchlib import (
+        build_resnet_task,
+        dp_sharded_step,
+    )
+
+    task = build_resnet_task(num_classes=num_classes, on_accel=True)
+    step, state, batch = dp_sharded_step(
+        task, n_devices, batch_per_device, image, num_classes=num_classes,
+        donate=False,  # lowering only; donation would just warn
+    )
+    hlo = step.lower(state, batch).compile().as_text()
+
+    # Instruction lines look like either
+    #   %x = f32[25583592]{0} all-reduce(...)
+    # or (XLA groups several reductions into one collective)
+    #   %x = (f32[64]{0}, f32[64]{0}) all-reduce(...)
+    # — sum every array in the result shape, which is what the collective
+    # moves per chip. Async pairs are counted at `all-reduce-done` (whose
+    # shape is just the result); the matching `-start` carries an
+    # (operands, results) tuple that would double-count.
+    shape_pat = re.compile(r"(\w+)\[([\d,]*)\]")
+    total = 0
+    breakdown: dict[str, int] = {}
+    for line in hlo.splitlines():
+        if " all-reduce(" in line:
+            op = line.find(" all-reduce(")
+        elif " all-reduce-done(" in line:
+            op = line.find(" all-reduce-done(")
+        else:
+            continue
+        eq = line.find("= ")
+        if eq < 0 or op < eq:
+            continue
+        for dtype, dims in shape_pat.findall(line[eq:op]):
+            if dtype not in DTYPE_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes = n * DTYPE_BYTES[dtype]
+            total += nbytes
+            key = f"{dtype}[{dims}]"
+            breakdown[key] = breakdown.get(key, 0) + nbytes
+    if total < 4 * 25_000_000:
+        # ResNet-50 DP must all-reduce >= its ~25.6M f32 gradients; less
+        # means the HLO text stopped matching (renamed ops, a
+        # reduce-scatter decomposition, changed formatting) and a silent
+        # zero would fabricate a perfect-efficiency prediction.
+        raise RuntimeError(
+            f"parsed only {total} all-reduce bytes from HLO — parser no "
+            "longer matches this XLA version's collective text"
+        )
+    top = dict(sorted(breakdown.items(), key=lambda kv: -kv[1])[:6])
+    return total, top
+
+
+def predict(allreduce_bytes: int) -> dict:
+    chips = [1, 2, 4, 8, 16, 32]
+    out: dict = {}
+    for batch, ips in MEASURED_ON_CHIP["images_per_sec_by_batch"].items():
+        t_compute = batch / ips  # seconds/step on one chip
+        rows = {}
+        for name, bw in ICI_EFFECTIVE_GBPS.items():
+            per_n = {}
+            for n in chips:
+                t_comm = 2.0 * (n - 1) / n * allreduce_bytes / bw
+                eff_no = t_compute / (t_compute + t_comm)
+                eff_full = t_compute / max(t_compute, t_comm)
+                per_n[str(n)] = {
+                    "t_comm_ms": round(t_comm * 1e3, 3),
+                    "eff_no_overlap": round(eff_no, 4),
+                    "eff_full_overlap": round(eff_full, 4),
+                }
+            rows[name] = per_n
+        out[str(batch)] = {
+            "t_compute_ms": round(t_compute * 1e3, 2),
+            "by_bandwidth": rows,
+        }
+    return out
+
+
+def main() -> None:
+    allreduce_bytes, top = measure_allreduce_bytes()
+    predictions = predict(allreduce_bytes)
+    # Headline at the measured sweet-spot batch (max per-chip throughput),
+    # so a refreshed sweep with a different batch grid still works.
+    table = MEASURED_ON_CHIP["images_per_sec_by_batch"]
+    best_batch = max(table, key=table.get)
+    primary = (
+        predictions[str(best_batch)]["by_bandwidth"]
+        ["ring_link_pair_primary"]["32"]
+    )
+    result = {
+        "metric": "resnet50_dp_predicted_scaling_efficiency_32chip",
+        "value": primary["eff_no_overlap"],
+        "unit": f"fraction (pessimistic no-overlap bound, batch "
+        f"{best_batch}/chip, 100 GB/s effective ICI)",
+        "full_overlap_value": primary["eff_full_overlap"],
+        "allreduce_bytes_per_step": allreduce_bytes,
+        "allreduce_top_shapes_bytes": top,
+        "measured_inputs": MEASURED_ON_CHIP,
+        "ici_assumptions_bytes_per_sec": ICI_EFFECTIVE_GBPS,
+        "topology_note": "32 chips sit inside one v5e ICI pod (<=256), "
+        "no DCN hop modeled; ring all-reduce moves 2(N-1)/N x bytes/chip",
+        "predictions": predictions,
+        "north_star": {"target": 0.90, "met_by_prediction":
+                       primary["eff_no_overlap"] >= 0.90},
+    }
+    with open("SCALING_MODEL.json", "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({k: result[k] for k in
+                      ("metric", "value", "full_overlap_value",
+                       "allreduce_bytes_per_step", "north_star")}))
+
+
+if __name__ == "__main__":
+    main()
