@@ -418,8 +418,7 @@ def register_train(sub: argparse._SubParsersAction) -> None:
     tr.add_argument("--checkpoint-dir", default=None)
     tr.add_argument("--resume", action="store_true")
     tr.add_argument("--profile-dir", default=None)
-    tr.add_argument("--experiment", default="imagenet")
-    tr.add_argument("--tracking-root", default=None)
+    _add_tracking_args(tr, "imagenet")
     tr.add_argument(
         "--coordinator", default=None,
         help="host:port for multi-host rendezvous (process 0)",
@@ -497,14 +496,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
         )
         init_state = task.state_from_variables(variables)
 
-    tracker = None
-    if args.tracking_root:
-        from ..tracking import RunStore
-
-        tracker = RunStore(args.tracking_root, args.experiment, run_name="train")
-        tracker.log_params(
-            {k: v for k, v in vars(args).items() if k != "fn" and v is not None}
-        )
+    tracker = _open_tracker(args, "train")
+    if tracker is not None:
+        tracker.log_params(_args_params(args))
 
     trainer = Trainer(
         TrainerConfig(
@@ -546,8 +540,10 @@ def _cmd_train(args: argparse.Namespace) -> int:
         )
 
     last = result.history[-1] if result.history else {}
-    if tracker is not None:
-        tracker.finish()
+    # Epoch metrics were logged by the Trainer as they happened; the
+    # close prints the "run ->" pointer BEFORE the JSON summary so the
+    # last stdout line stays machine-parseable.
+    _finish_tracker(tracker)
     print(
         json.dumps(
             {
@@ -771,8 +767,7 @@ def register_lm(sub: argparse._SubParsersAction) -> None:
     lm.add_argument("--limit-val-batches", type=int, default=5)
     lm.add_argument("--checkpoint-dir", default=None)
     lm.add_argument("--resume", action="store_true")
-    lm.add_argument("--experiment", default="lm")
-    lm.add_argument("--tracking-root", default=None)
+    _add_tracking_args(lm, "lm")
     lm.add_argument(
         "--coordinator", default=None,
         help="host:port for multi-host rendezvous (process 0)",
@@ -826,14 +821,9 @@ def _cmd_lm(args: argparse.Namespace) -> int:
         aux_loss_weight=args.aux_loss_weight if args.ffn == "moe" else 0.0,
     )
 
-    tracker = None
-    if args.tracking_root:
-        from ..tracking import RunStore
-
-        tracker = RunStore(args.tracking_root, args.experiment, run_name="lm")
-        tracker.log_params(
-            {k: v for k, v in vars(args).items() if k != "fn" and v is not None}
-        )
+    tracker = _open_tracker(args, "lm")
+    if tracker is not None:
+        tracker.log_params(_args_params(args))
         tracker.log_params({"entropy_floor": floor})
 
     trainer = Trainer(
@@ -861,8 +851,7 @@ def _cmd_lm(args: argparse.Namespace) -> int:
             sample_seed=args.seed + 100_000,
         ),
     )
-    if tracker is not None:
-        tracker.finish()
+    _finish_tracker(tracker)
     last = result.history[-1] if result.history else {}
     print(
         json.dumps(
@@ -1064,6 +1053,14 @@ def _open_tracker(args: argparse.Namespace, run_name: str):
     from ..tracking import RunStore
 
     return RunStore(args.tracking_root, args.experiment, run_name=run_name)
+
+
+def _args_params(args: argparse.Namespace) -> dict:
+    """CLI invocation as loggable run params (internals and Nones dropped)."""
+    skip = {"fn", "no_tracking", "tracking_root"}
+    return {
+        k: v for k, v in vars(args).items() if k not in skip and v is not None
+    }
 
 
 def _finish_tracker(tracker, params: dict | None = None,
