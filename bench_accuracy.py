@@ -97,6 +97,9 @@ def main() -> int:
     model = ResNet(
         stage_sizes=[1, 1], block_cls=ResNetBlock, num_filters=16,
         num_classes=args.classes,
+        # The production default: the accuracy band then also guards the
+        # fused custom-VJP training path end to end.
+        fused_bn=True,
     )
     task = ClassifierTask(model=model, tx=optax.adam(1e-3))
     store = RunStore(str(workdir / "runs"), "accuracy_proof", run_name="train")

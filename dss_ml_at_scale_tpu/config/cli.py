@@ -118,7 +118,15 @@ def main(argv: list[str] | None = None) -> int:
     if not getattr(args, "fn", None):
         parser.print_help()
         return 2
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BaseException:
+        # A crashed command must not leave its (default-on) tracking run
+        # in RUNNING state — close it as FAILED before propagating.
+        from .commands import fail_active_tracker
+
+        fail_active_tracker()
+        raise
 
 
 if __name__ == "__main__":

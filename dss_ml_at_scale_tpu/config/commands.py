@@ -1044,15 +1044,35 @@ def _add_tracking_args(parser, experiment: str) -> None:
     )
 
 
+# The one tracker a CLI invocation may have open. cli.main closes it as
+# FAILED when a command raises, so a crashed run (bad table, OOM,
+# Ctrl-C) never lingers in RUNNING state in the run store.
+_active_tracker = None
+
+
 def _open_tracker(args: argparse.Namespace, run_name: str):
     """RunStore for a CLI run, or None when tracking is opted out."""
+    global _active_tracker
     if getattr(args, "no_tracking", False) or not getattr(
         args, "tracking_root", None
     ):
         return None
     from ..tracking import RunStore
 
-    return RunStore(args.tracking_root, args.experiment, run_name=run_name)
+    _active_tracker = RunStore(
+        args.tracking_root, args.experiment, run_name=run_name
+    )
+    return _active_tracker
+
+
+def fail_active_tracker() -> None:
+    """Close a command's still-open run as FAILED (crash path)."""
+    global _active_tracker
+    if _active_tracker is not None:
+        try:
+            _active_tracker.finish("FAILED")
+        finally:
+            _active_tracker = None
 
 
 def _args_params(args: argparse.Namespace) -> dict:
@@ -1067,6 +1087,7 @@ def _finish_tracker(tracker, params: dict | None = None,
                     metrics: dict | None = None, step: int | None = None):
     """The one place a CLI run is closed: final params/metrics, FINISHED
     status, and the 'run ->' pointer the user needs to find it."""
+    global _active_tracker
     if tracker is None:
         return
     if params:
@@ -1074,6 +1095,8 @@ def _finish_tracker(tracker, params: dict | None = None,
     if metrics:
         tracker.log_metrics(metrics, step=step)
     tracker.finish()
+    if tracker is _active_tracker:
+        _active_tracker = None
     print(f"run -> {tracker.path}")
 
 

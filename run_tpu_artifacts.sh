@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
 # One-shot on-chip artifact refresh for when the accelerator tunnel is up:
 #   ./run_tpu_artifacts.sh [out_suffix]
-# Runs the headline bench (probe-gated, watchdogged) and the accuracy
-# proof on the real chip, writing BENCH_local{suffix}.json and
-# ACCURACY_r03.json. Safe to run against a dead tunnel: the bench
-# degrades with a diagnosis in ~25 min instead of hanging.
+# Runs the headline bench (probe-gated, watchdogged), the accuracy
+# proof, and the scaling roofline refresh on the real chip, writing
+# BENCH_local{suffix}.json, ACCURACY_r04.json, and SCALING_MODEL.json.
+# Safe to run against a dead tunnel: the bench degrades with a
+# diagnosis in ~25 min instead of hanging.
 set -u
 cd "$(dirname "$0")"
 SUFFIX="${1:-}"
@@ -30,4 +31,8 @@ if [ $PROBE_RC -eq 0 ]; then
   echo "== accuracy proof on chip =="
   timeout 1800 python bench_accuracy.py --out ACCURACY_r04.json
   echo "accuracy rc=$?"
+
+  echo "== scaling roofline from the fresh on-chip sweep =="
+  timeout 900 python scaling_model.py --bench-json "BENCH_local${SUFFIX}.json"
+  echo "scaling model rc=$?"
 fi

@@ -33,6 +33,7 @@ hop enters the model. Writes SCALING_MODEL.json and prints one JSON line.
 
 from __future__ import annotations
 
+import argparse
 import json
 import re
 
@@ -151,7 +152,39 @@ def predict(allreduce_bytes: int) -> dict:
     return out
 
 
+def refresh_measured(bench_json: str) -> None:
+    """Replace the embedded step-time table with a real on-chip sweep
+    (a bench.py artifact with platform == "tpu")."""
+    with open(bench_json, encoding="utf-8") as f:
+        bench = json.load(f)
+    if bench.get("platform") != "tpu":
+        raise SystemExit(
+            f"{bench_json} has platform={bench.get('platform')!r}, not "
+            "'tpu' — refusing to model ICI scaling from non-chip (or "
+            "unattributed) step times"
+        )
+    table = {
+        int(p["batch"]): float(p["images_per_sec"])
+        for p in bench.get("sweep", [])
+        if "images_per_sec" in p
+    }
+    if not table:
+        raise SystemExit(f"{bench_json} carries no usable sweep points")
+    MEASURED_ON_CHIP["images_per_sec_by_batch"] = table
+    MEASURED_ON_CHIP["device"] = bench.get("device", "tpu")
+    MEASURED_ON_CHIP["source"] = bench_json
+
+
 def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--bench-json", default=None,
+        help="refresh the measured step-time table from a bench.py "
+        "artifact (platform must be tpu)",
+    )
+    args = ap.parse_args()
+    if args.bench_json:
+        refresh_measured(args.bench_json)
     allreduce_bytes, top = measure_allreduce_bytes()
     predictions = predict(allreduce_bytes)
     # Headline at the measured sweet-spot batch (max per-chip throughput),

@@ -65,6 +65,26 @@ def test_hpo_closure_mode(tmp_path, monkeypatch, capsys):
     assert sum(1 for m in metrics if m["name"] == "loss") >= 2
 
 
+def test_crashed_command_closes_run_as_failed(tmp_path, monkeypatch, capsys):
+    # With tracking default-on, a command that raises AFTER its run is
+    # opened must not leave the run in RUNNING state (phantom runs).
+    from dss_ml_at_scale_tpu.datagen.images import write_image_delta
+
+    monkeypatch.chdir(tmp_path)
+    table = tmp_path / "imgs"
+    write_image_delta(table, 32, classes=4, size=32)
+    with pytest.raises(FileNotFoundError):
+        main([
+            "train", "--data", str(table), "--val-data", "/nonexistent/val",
+            "--model", "tiny", "--num-classes", "4", "--crop", "32",
+            "--batch-size", "8", "--epochs", "1",
+        ])
+    capsys.readouterr()
+    metas = list((tmp_path / "dsst_runs" / "imagenet").glob("*/meta.json"))
+    assert len(metas) == 1
+    assert json.loads(metas[0].read_text())["status"] == "FAILED"
+
+
 def test_hpo_no_tracking_opt_out(tmp_path, monkeypatch, capsys):
     monkeypatch.chdir(tmp_path)
     assert main([
