@@ -468,17 +468,26 @@ def _cmd_train(args: argparse.Namespace) -> int:
         torch_padding = False
     if meta_path is not None and topo.process_index == 0:
         meta_path.parent.mkdir(parents=True, exist_ok=True)
-        meta_path.write_text(
-            json.dumps(
-                {
-                    "torch_padding": torch_padding,
-                    "model": args.model,
-                    "num_classes": args.num_classes,
-                    "crop": args.crop,
-                    "fused_bn": args.fused_bn,
-                }
-            )
-        )
+        meta = {
+            "torch_padding": torch_padding,
+            "model": args.model,
+            "num_classes": args.num_classes,
+            "crop": args.crop,
+            "fused_bn": args.fused_bn,
+        }
+        # Tables from dsst ingest carry their label vocabulary; persist
+        # it WITH the checkpoint (position = model output index), so
+        # predict names classes by the vocabulary the model was trained
+        # on — never by whatever table it later scores.
+        train_labels = Path(args.data) / "labels.json"
+        if train_labels.exists():
+            vocab = json.loads(train_labels.read_text())
+            names = [None] * args.num_classes
+            for name, idx in vocab.items():
+                if 0 <= int(idx) < args.num_classes:
+                    names[int(idx)] = name
+            meta["label_names"] = names
+        meta_path.write_text(json.dumps(meta))
     model = _build_classifier_model(
         args.model, num_classes=args.num_classes, torch_padding=torch_padding,
         fused_bn=args.fused_bn,
@@ -701,14 +710,25 @@ def _cmd_predict(args: argparse.Namespace) -> int:
     if total == 0:
         print("no rows to score")
         return 1
-    out_table = pa.table(
-        {
-            "row": pa.array(np.arange(total, dtype=np.int64)),
-            "label_index": pa.array(np.concatenate(rows_label).astype(np.int64)),
-            "pred_index": pa.array(np.concatenate(rows_pred).astype(np.int64)),
-            "pred_prob": pa.array(np.concatenate(rows_prob).astype(np.float64)),
-        }
-    )
+    preds = np.concatenate(rows_pred).astype(np.int64)
+    columns = {
+        "row": pa.array(np.arange(total, dtype=np.int64)),
+        "label_index": pa.array(np.concatenate(rows_label).astype(np.int64)),
+        "pred_index": pa.array(preds),
+        "pred_prob": pa.array(np.concatenate(rows_prob).astype(np.float64)),
+    }
+    # Map indices to names via the vocabulary persisted WITH the
+    # checkpoint at train time (the reference's predictions are wnid
+    # strings for the same reason). Deliberately NOT the scoring table's
+    # labels.json: a different table's first-encounter order would
+    # silently mislabel.
+    names = meta.get("label_names")
+    if names:
+        columns["pred_label"] = pa.array(
+            [names[i] if 0 <= i < len(names) else None for i in preds],
+            type=pa.string(),
+        )
+    out_table = pa.table(columns)
     write_delta(out_table, args.out)
     print(
         json.dumps(

@@ -432,6 +432,9 @@ def test_predict_cli_round_trip(tmp_path, capsys, devices8):
         "train", "--data", str(data), "--model", "tiny",
         "--num-classes", "4", "--crop", "64", "--batch-size", "16",
         "--epochs", "5", "--learning-rate", "0.01",
+        # Single reader worker: deterministic batch order, so the
+        # accuracy assertion can't flake on thread scheduling.
+        "--workers", "1",
         "--checkpoint-dir", str(ckpt),
         "--val-data", str(data),
     ]) == 0
@@ -534,6 +537,29 @@ def test_datagen_photos_and_ingest_label_index(tmp_path, capsys):
     assert sorted(vocab) == ["china", "flower"]
     for _, row in df.iterrows():
         assert row["label_index"] == vocab[row["object_id"]]
+
+    # predict maps indices back through the ingested vocabulary.
+    # (batch sizes must divide the simulated 8-device mesh's data axis)
+    assert main([
+        "train", "--data", str(tmp_path / "table"),
+        "--model", "tiny", "--num-classes", "2", "--crop", "32",
+        "--batch-size", "8", "--epochs", "1",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ]) == 0
+    assert main([
+        "predict", "--data", str(tmp_path / "table"),
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--out", str(tmp_path / "preds"), "--batch-size", "8",
+    ]) == 0
+    # The vocabulary rides the CHECKPOINT (dsst_model.json), not the
+    # scoring table — a differently-ordered table must not mislabel.
+    meta = json.loads((tmp_path / "ckpt" / "dsst_model.json").read_text())
+    names = meta["label_names"]
+    assert sorted(names) == ["china", "flower"]
+    preds = _read_delta_pandas(tmp_path / "preds")
+    assert set(preds["pred_label"]) <= {"china", "flower"}
+    for _, row in preds.iterrows():
+        assert row["pred_label"] == names[row["pred_index"]]
     capsys.readouterr()
 
 
