@@ -359,7 +359,7 @@ def _write_jpeg_table(path, *, n_images: int, source_size: int, seed: int = 0):
     return jpegs
 
 
-def _bench_pipeline(jax, train_step, task, compute_ips: float, *,
+def _bench_pipeline(jax, task, compute_ips: float, *,
                     batch_size: int, image: int, source_size: int, steps: int,
                     workers: int, tmpdir: str):
     """Per-stage input-pipeline measurement.
@@ -371,8 +371,11 @@ def _bench_pipeline(jax, train_step, task, compute_ips: float, *,
        reader, no device;
     2. reader-only: Delta table → sharded reader → decode pool → host
        batches — no device;
-    3. e2e: the same stream prefetched to device feeding the SAME
-       compiled train step as the compute phase.
+    3. e2e: the same stream prefetched to device feeding a train step
+       specialized to the pipeline's uint8 batches. The stall fraction
+       is computed against a compute-only run of THAT executable on a
+       device-resident uint8 batch — same program both sides, so
+       normalize-in-step cost can never masquerade as input stall.
     """
     from pathlib import Path
 
@@ -386,12 +389,19 @@ def _bench_pipeline(jax, train_step, task, compute_ips: float, *,
     jpegs = _write_jpeg_table(
         table_path, n_images=n_images, source_size=source_size
     )
-    spec = imagenet_transform_spec(resize=image + image // 8, crop=image)
+    # uint8 transfer mode: raw quantized bytes through queue + transfer
+    # (4x less than float32), normalized inside the jitted step — the
+    # tightest pipeline configuration, which is what the on-chip
+    # stall-fraction target is measured against.
+    spec = imagenet_transform_spec(
+        resize=image + image // 8, crop=image, output_dtype="uint8"
+    )
     host_cores = os.cpu_count() or 1
 
     out = {
         "decode_backend": spec.backend,
         "image_layout": spec.layout,
+        "transfer_dtype": "uint8",
         "reader_workers": workers,
         "host_cores": host_cores,
     }
@@ -438,10 +448,35 @@ def _bench_pipeline(jax, train_step, task, compute_ips: float, *,
     )
 
     # -- stage 3: end-to-end -------------------------------------------------
+    import numpy as np
+
     state = task.init_state(
         jax.random.key(0),
         synthetic_image_batch(batch_size, image, num_classes=1000),
     )
+    # The compute-phase executable is AOT-specialized to float32
+    # synthetic batches; the pipeline feeds uint8 (normalize-in-step), so
+    # e2e gets its own jit — and its OWN compute-only reference on a
+    # device-resident uint8 batch, so the stall fraction compares the
+    # same program against itself and normalize-in-step cost can never
+    # read as input stall.
+    e2e_step = jax.jit(task.train_step, donate_argnums=0)
+    rng = np.random.default_rng(0)
+    u8_batch = jax.device_put({
+        "image": rng.integers(0, 256, (batch_size, image, image, 3),
+                              dtype=np.uint8),
+        "label": rng.integers(0, 1000, batch_size).astype(np.int32),
+    })
+    for _ in range(2):  # warmup incl. the uint8-specialized compile
+        state, metrics = e2e_step(state, u8_batch)
+    float(metrics["train_loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = e2e_step(state, u8_batch)
+    float(metrics["train_loss"])
+    u8_compute_ips = batch_size * steps / (time.perf_counter() - t0)
+    out["compute_images_per_sec_uint8_step"] = round(u8_compute_ips, 2)
+
     with batch_loader(
         table_path,
         batch_size=batch_size,
@@ -452,23 +487,25 @@ def _bench_pipeline(jax, train_step, task, compute_ips: float, *,
     ) as reader:
         batches = prefetch_to_devices(iter(reader), depth=2)
         for _ in range(2):  # warmup: fill prefetch + first dispatch
-            state, metrics = train_step(state, next(batches))
+            state, metrics = e2e_step(state, next(batches))
         float(metrics["train_loss"])
         t0 = time.perf_counter()
         for _ in range(steps):
-            state, metrics = train_step(state, next(batches))
+            state, metrics = e2e_step(state, next(batches))
         float(metrics["train_loss"])
         dt = time.perf_counter() - t0
     e2e_ips = batch_size * steps / dt
     out["e2e_images_per_sec"] = round(e2e_ips, 2)
-    if compute_ips > 0:
+    if u8_compute_ips > 0:
         out["input_stall_fraction"] = round(
-            max(0.0, 1.0 - e2e_ips / compute_ips), 4
+            max(0.0, 1.0 - e2e_ips / u8_compute_ips), 4
         )
     # Accounting: e2e should track min(reader capacity, compute). If it
     # doesn't, the gap is prefetch/transfer overhead — record the bound
     # so the artifact is self-explaining.
-    out["e2e_bound"] = round(min(out["reader_images_per_sec"], compute_ips), 2)
+    out["e2e_bound"] = round(
+        min(out["reader_images_per_sec"], u8_compute_ips), 2
+    )
     return out
 
 
@@ -598,7 +635,7 @@ def child_train() -> None:
             try:
                 workers = min(8, os.cpu_count() or 2)
                 result["pipeline"] = _bench_pipeline(
-                    jax, train_step, task, ips,
+                    jax, task, ips,
                     batch_size=best_batch, image=image,
                     source_size=image + image // 4,
                     steps=steps, workers=workers, tmpdir=tmpdir,

@@ -468,13 +468,17 @@ def _cmd_train(args: argparse.Namespace) -> int:
         torch_padding = False
     if meta_path is not None and topo.process_index == 0:
         meta_path.parent.mkdir(parents=True, exist_ok=True)
-        meta = {
-            "torch_padding": torch_padding,
-            "model": args.model,
-            "num_classes": args.num_classes,
-            "crop": args.crop,
-            "fused_bn": args.fused_bn,
-        }
+        # Merge over any existing metadata: a resume whose --data table
+        # carries no labels.json must not silently drop the label_names
+        # persisted by the original training run.
+        meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+        meta.update(
+            torch_padding=torch_padding,
+            model=args.model,
+            num_classes=args.num_classes,
+            crop=args.crop,
+            fused_bn=args.fused_bn,
+        )
         # Tables from dsst ingest carry their label vocabulary; persist
         # it WITH the checkpoint (position = model output index), so
         # predict names classes by the vocabulary the model was trained
