@@ -1,9 +1,11 @@
-"""JAX numerical kernels for the time-series track.
+"""JAX numerical kernels: time-series fits + the hot deep-learning ops.
 
 TPU-native replacement for the statsmodels surface the reference
 exercises (SURVEY.md §2.2 X10): SARIMAX state-space ML fit, Holt-Winters
 exponential smoothing, ARMA sample generation, plus the vmappable
 Nelder-Mead optimizer that statsmodels' ``fit(method='nm')`` maps to.
+The deep-learning hot path adds the Pallas flash-attention kernel and
+the fused BN+act custom VJP (``fused_norm``) that cuts ResNet HBM bytes.
 
 Everything here is pure JAX (``lax.scan`` / ``lax.while_loop``), built to
 ``vmap`` across thousands of SKU groups at once — one sharded batched fit
@@ -13,6 +15,7 @@ replaces the reference's one-Spark-task-per-group Python processes
 
 from .arma import arma_generate_sample, lfilter
 from .flash_attention import attention_reference, flash_attention
+from .fused_norm import bn_act
 from .holt_winters import HoltWintersResult, holt_winters_fit, holt_winters_forecast
 from .kalman import kalman_filter, kalman_forecast
 from .neldermead import NelderMeadResult, nelder_mead
@@ -30,6 +33,7 @@ __all__ = [
     "lfilter",
     "attention_reference",
     "flash_attention",
+    "bn_act",
     "HoltWintersResult",
     "holt_winters_fit",
     "holt_winters_forecast",
