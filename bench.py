@@ -509,6 +509,10 @@ def _bench_pipeline(jax, task, compute_ips: float, *,
     return out
 
 
+def _append_note(result: dict, msg: str) -> None:
+    result["note"] = (result.get("note", "") + " | " + msg).strip(" |")
+
+
 def child_train() -> None:
     result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -551,9 +555,7 @@ def child_train() -> None:
         t_start = time.perf_counter()
         for bs in batches:
             if sweep and time.perf_counter() - t_start > 300:
-                result.setdefault("note", "")
-                result["note"] = (result["note"] + " | sweep truncated by "
-                                  "time budget").strip(" |")
+                _append_note(result, "sweep truncated by time budget")
                 break
             try:
                 train_step, ips, cost = _bench_compute_at(
@@ -607,7 +609,7 @@ def child_train() -> None:
                 unfused_task = build_resnet_task(
                     num_classes=1000, on_accel=on_accel, fused_bn=False
                 )
-                _, unfused_ips, _ = _bench_compute_at(
+                unfused_step, unfused_ips, _ = _bench_compute_at(
                     jax, unfused_task, best_batch, image, steps
                 )
                 result["unfused"] = {
@@ -615,6 +617,27 @@ def child_train() -> None:
                     "images_per_sec": round(unfused_ips, 2),
                     "fused_speedup": round(ips / unfused_ips, 4),
                 }
+                if unfused_ips > ips:
+                    # Insurance for the driver's one shot: if the fused
+                    # path ever regresses on real hardware, the headline
+                    # must be the best the framework can do, with the
+                    # regression recorded rather than reported as the
+                    # result. The downstream profile/pipeline sections
+                    # follow the swap so every block of the artifact
+                    # describes the SAME (headline) program.
+                    train_step, task, ips = unfused_step, unfused_task, unfused_ips
+                    result.update(
+                        value=round(unfused_ips, 2),
+                        unit=f"images/sec (batch {best_batch}, "
+                        f"{device_kind}, unfused BN)",
+                        vs_baseline=round(unfused_ips / A100_IMG_PER_SEC, 4),
+                    )
+                    _append_note(
+                        result,
+                        "fused-BN path measured slower than unfused at the "
+                        "winning batch; headline, profile, and pipeline all "
+                        "use the unfused program",
+                    )
             except Exception as e:
                 result["unfused"] = {
                     "error": f"{type(e).__name__}: {e}"[:200]
