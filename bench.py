@@ -626,6 +626,15 @@ def child_train() -> None:
                     # follow the swap so every block of the artifact
                     # describes the SAME (headline) program.
                     train_step, task, ips = unfused_step, unfused_task, unfused_ips
+                    for point in sweep:
+                        # The sweep feeds scaling_model.py's step-time
+                        # table; the winning point must carry the
+                        # headline (unfused) rate, with the fused one
+                        # preserved under an explicit key.
+                        if point.get("batch") == best_batch and "images_per_sec" in point:
+                            point["images_per_sec_fused"] = point["images_per_sec"]
+                            point["images_per_sec"] = round(unfused_ips, 2)
+                            point["bn"] = "unfused"
                     result.update(
                         value=round(unfused_ips, 2),
                         unit=f"images/sec (batch {best_batch}, "
@@ -666,8 +675,7 @@ def child_train() -> None:
             except Exception:
                 result["pipeline"] = {"error": traceback.format_exc(limit=5)}
     except Exception:
-        note = traceback.format_exc(limit=5)
-        result["note"] = (result.get("note", "") + " | " + note).strip(" |")
+        _append_note(result, traceback.format_exc(limit=5))
         result["failed"] = True  # tells the parent to retry / fall back
     print(json.dumps(result))
 

@@ -35,4 +35,8 @@ if [ $PROBE_RC -eq 0 ]; then
   echo "== scaling roofline from the fresh on-chip sweep =="
   timeout 900 python scaling_model.py --bench-json "BENCH_local${SUFFIX}.json"
   echo "scaling model rc=$?"
+
+  echo "== 2-device DeviceTrials smoke (skips on 1-device hosts) =="
+  timeout 600 python smoke_two_device_trials.py
+  echo "2dev smoke rc=$?"
 fi

@@ -311,3 +311,22 @@ def test_fmin_nonfinite_loss_is_isolated():
     best = fmin(obj, {"x": hp.uniform("x", 0, 5)}, max_evals=15, trials=trials, rstate=0)
     assert abs(best["x"] - 2.0) < 1.5
     assert sum(r["status"] == "fail" for r in trials.results) == 1
+
+
+def test_two_device_trials_smoke_logic(tmp_path, monkeypatch, devices8):
+    # The on-chip 2-device smoke's pass-path logic, driven on the
+    # simulated slice: two pinned trials must use distinct devices and
+    # genuinely overlap. On real hardware the driver runs the script via
+    # run_tpu_artifacts.sh with the cpu guard active.
+    monkeypatch.setenv("DSST_SMOKE_ALLOW_CPU", "1")
+    monkeypatch.chdir(tmp_path)
+    import smoke_two_device_trials as smoke
+
+    assert smoke.main() == 0
+    import json
+
+    out = json.loads((tmp_path / "TRIALS_2DEV.json").read_text())
+    assert out["passed"] is True
+    assert out["trials_ok"] == 8
+    assert len(out["distinct_devices_used"]) >= 2
+    assert out["max_concurrent"] >= 2
