@@ -45,13 +45,14 @@ def test_param_tree_identical(block):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_train_forward_and_stats_parity():
+@pytest.mark.parametrize("block", [BottleneckBlock, ResNetBlock])
+def test_train_forward_and_stats_parity(block):
     x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3))
-    variables = _tiny(False).init(jax.random.key(0), x)
-    out_p, upd_p = _tiny(False).apply(
+    variables = _tiny(False, block).init(jax.random.key(0), x)
+    out_p, upd_p = _tiny(False, block).apply(
         variables, x, train=True, mutable=["batch_stats"]
     )
-    out_f, upd_f = _tiny(True).apply(
+    out_f, upd_f = _tiny(True, block).apply(
         variables, x, train=True, mutable=["batch_stats"]
     )
     np.testing.assert_allclose(out_f, out_p, rtol=0, atol=2e-4)
@@ -71,10 +72,11 @@ def test_eval_forward_parity():
     np.testing.assert_allclose(out_f, out_p, rtol=0, atol=2e-4)
 
 
-def test_grad_parity_through_training_loss():
+@pytest.mark.parametrize("block", [BottleneckBlock, ResNetBlock])
+def test_grad_parity_through_training_loss(block):
     x = jax.random.normal(jax.random.key(3), (4, 32, 32, 3))
     y = jnp.array([0, 1, 2, 3])
-    variables = _tiny(False).init(jax.random.key(0), x)
+    variables = _tiny(False, block).init(jax.random.key(0), x)
 
     def loss(params, model):
         logits, _ = model.apply(
@@ -84,8 +86,8 @@ def test_grad_parity_through_training_loss():
         onehot = jax.nn.one_hot(y, logits.shape[-1])
         return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), -1))
 
-    g_p = jax.grad(loss)(variables["params"], _tiny(False))
-    g_f = jax.grad(loss)(variables["params"], _tiny(True))
+    g_p = jax.grad(loss)(variables["params"], _tiny(False, block))
+    g_f = jax.grad(loss)(variables["params"], _tiny(True, block))
     flat_p = jax.tree_util.tree_leaves_with_path(g_p)
     flat_f = dict(
         ("/".join(map(str, p)), v)
