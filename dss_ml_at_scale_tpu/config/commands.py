@@ -357,6 +357,20 @@ def register_train(sub: argparse._SubParsersAction) -> None:
     tr.add_argument("--epochs", type=int, default=2)
     tr.add_argument("--batch-size", type=int, default=212)
     tr.add_argument("--learning-rate", type=float, default=1e-5)
+    tr.add_argument(
+        "--lr-schedule", choices=["constant", "cosine"], default=None,
+        help="constant reproduces the reference recipe (Adam 1e-5, "
+        "2...py:383); cosine adds linear warmup to --learning-rate then "
+        "cosine decay to 0 over the current run's total steps — the "
+        "standard from-scratch ResNet schedule. Default: the value "
+        "persisted in the checkpoint dir (a flag-less --resume keeps the "
+        "trained schedule's optimizer structure), else constant",
+    )
+    tr.add_argument(
+        "--warmup-steps", type=int, default=None,
+        help="warmup length for --lr-schedule cosine (default: 5%% of "
+        "total steps)",
+    )
     tr.add_argument("--num-classes", type=int, default=1000)
     tr.add_argument("--crop", type=int, default=224)
     tr.add_argument("--model", choices=["resnet50", "tiny"], default="resnet50")
@@ -466,6 +480,18 @@ def _cmd_train(args: argparse.Namespace) -> int:
         )
     else:
         torch_padding = False
+    # Same resolution for the LR schedule: the scheduled optimizer has a
+    # different opt_state STRUCTURE (ScaleByScheduleState count), so a
+    # flag-less --resume must rebuild what the checkpoint was trained
+    # with or the Orbax restore structure-mismatches.
+    if args.lr_schedule is not None:
+        lr_schedule = args.lr_schedule
+    elif meta_path is not None and meta_path.exists():
+        lr_schedule = json.loads(meta_path.read_text()).get(
+            "lr_schedule", "constant"
+        )
+    else:
+        lr_schedule = "constant"
     if meta_path is not None and topo.process_index == 0:
         meta_path.parent.mkdir(parents=True, exist_ok=True)
         # Merge over any existing metadata: a resume whose --data table
@@ -478,6 +504,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
             num_classes=args.num_classes,
             crop=args.crop,
             fused_bn=args.fused_bn,
+            lr_schedule=lr_schedule,
         )
         # Tables from dsst ingest carry their label vocabulary; persist
         # it WITH the checkpoint (position = model output index), so
@@ -496,7 +523,25 @@ def _cmd_train(args: argparse.Namespace) -> int:
         args.model, num_classes=args.num_classes, torch_padding=torch_padding,
         fused_bn=args.fused_bn,
     )
-    task = ClassifierTask(model=model, tx=optax.adam(args.learning_rate))
+    if lr_schedule == "cosine":
+        # Same steps/epoch arithmetic the Trainer uses (rows // global
+        # batch), so the decay horizon matches the actual run length.
+        steps_per_epoch = rows // (args.batch_size * topo.process_count)
+        total_steps = max(1, steps_per_epoch * args.epochs)
+        warmup = (
+            args.warmup_steps
+            if args.warmup_steps is not None
+            else max(1, total_steps // 20)
+        )
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=args.learning_rate,
+            warmup_steps=min(warmup, total_steps),
+            decay_steps=total_steps,
+        )
+    else:
+        lr = args.learning_rate
+    task = ClassifierTask(model=model, tx=optax.adam(lr))
 
     init_state = None
     if args.pretrained and not _has_checkpoint(args):
@@ -665,7 +710,18 @@ def _cmd_predict(args: argparse.Namespace) -> int:
         # trained for fidelity (older checkpoints predate the flag).
         fused_bn=bool(meta.get("fused_bn", False)),
     )
-    task = ClassifierTask(model=model)
+    if meta.get("lr_schedule", "constant") == "cosine":
+        # restore_state structure-matches the FULL TrainState, optimizer
+        # included; a scheduled adam stores an extra count leaf, so the
+        # template's tx must be schedule-shaped too (the schedule's
+        # values are irrelevant to inference).
+        import optax
+
+        task = ClassifierTask(
+            model=model, tx=optax.adam(optax.constant_schedule(1e-5))
+        )
+    else:
+        task = ClassifierTask(model=model)
 
     table = DeltaTable(args.data)
     spec = imagenet_transform_spec(crop=crop, backend=args.decode_backend)
