@@ -26,6 +26,8 @@ candidates). Alongside it:
   ``group_apply/02...py:516-528``) — SKUs/sec through the sharded
   vmapped tuner vs a measured sequential host estimate (run in its own
   watchdog child; see ``_group_child``).
+- ``lm``: long-context evidence — flash-attention transformer LM train
+  step at seq 2048, tokens/sec + MFU (own watchdog child).
 
 The reference publishes no numbers (BASELINE.md); the operative target is
 the driver-defined north star — ResNet-50 images/sec/chip vs an
@@ -62,10 +64,11 @@ PEAK_BF16_FLOPS = {"TPU v5 lite": 197e12, "TPU v4": 275e12}
 PEAK_HBM_BYTES = {"TPU v5 lite": 819e9, "TPU v4": 1228e9}
 
 _CHILD_ENV = "DSST_BENCH_CHILD"
-_MODE_ENV = "DSST_BENCH_MODE"  # "train" (default) | "group"
+_MODE_ENV = "DSST_BENCH_MODE"  # "train" (default) | "group" | "lm" | "probe"
 _FORCE_CPU_ENV = "DSST_BENCH_FORCE_CPU"
 _TIMEOUT_ENV = "DSST_BENCH_TIMEOUT"  # seconds per child attempt
 _GROUP_TIMEOUT_ENV = "DSST_BENCH_GROUP_TIMEOUT"
+_LM_TIMEOUT_ENV = "DSST_BENCH_LM_TIMEOUT"
 _PROBE_TIMEOUT_ENV = "DSST_BENCH_PROBE_TIMEOUT"
 
 
@@ -199,6 +202,30 @@ def parent_main() -> None:
             group = {"error": f"accelerator: {accel_reason}; cpu: {cpu_err}"}
     result["group"] = group
 
+    # Long-context LM block: flash-attention transformer tokens/sec.
+    # Same child/watchdog discipline; CPU fallback shrinks the model to a
+    # liveness check.
+    lt = float(os.environ.get(_LM_TIMEOUT_ENV, "600"))
+    lm = lerr = None
+    if accelerator_up:
+        if gerr is not None and "timed out" in str(gerr):
+            # A killed group child leaves the same stale device lease the
+            # train->group seam guards against; give it the observed
+            # recovery time or the lm child hangs on it too.
+            time.sleep(120.0)
+        lm, lerr = _run_child("lm", force_cpu=False, t=lt)
+    if lm is None:
+        lm, cpu_lerr = _run_child("lm", force_cpu=True, t=min(lt, 300.0))
+        if lm is not None:
+            lm["note"] = (
+                (f"{lerr}; " if lerr else "")
+                + "cpu liveness fallback — numbers not chip-representative"
+            )
+        else:
+            lm = {"error": f"accelerator: {lerr or 'probe failed'}; "
+                           f"cpu: {cpu_lerr}"}
+    result["lm"] = lm
+
     _emit(result, notes)
 
 
@@ -243,6 +270,19 @@ def _enable_compile_cache(jax) -> None:
 # Train child: compute sweep + profile + input pipeline
 # ---------------------------------------------------------------------------
 
+def _xla_cost(compiled) -> dict:
+    """Best-effort XLA cost analysis: {flops_per_step, bytes_per_step}."""
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        return {
+            "flops_per_step": float(ca.get("flops", 0.0)),
+            "bytes_per_step": float(ca.get("bytes accessed", 0.0)),
+        }
+    except Exception:
+        return {}  # cost analysis is best-effort; throughput still measures
+
+
 def _bench_compute_at(jax, task, batch_size: int, image: int, steps: int):
     """One sweep point: images/sec + XLA-counted flops/bytes per step.
 
@@ -261,16 +301,7 @@ def _bench_compute_at(jax, task, batch_size: int, image: int, steps: int):
     compiled = jax.jit(task.train_step, donate_argnums=0).lower(
         state, device_batch
     ).compile()
-    cost = {}
-    try:
-        ca = compiled.cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        cost = {
-            "flops_per_step": float(ca.get("flops", 0.0)),
-            "bytes_per_step": float(ca.get("bytes accessed", 0.0)),
-        }
-    except Exception:
-        pass  # cost analysis is best-effort; throughput still measures
+    cost = _xla_cost(compiled)
     _, dt = timed_train_steps(compiled, state, device_batch, steps)
     return compiled, batch_size * steps / dt, cost
 
@@ -804,6 +835,90 @@ def child_group() -> None:
     print(json.dumps(result))
 
 
+def child_lm() -> None:
+    """Long-context LM block: flash-attention transformer tokens/sec.
+
+    The framework claims long-context as first-class (ring/flash
+    attention, SURVEY.md §5.7); this records the single-chip evidence: a
+    causal transformer LM train step at seq 2048 with the Pallas flash
+    kernel, tokens/sec + XLA-counted MFU. Off-accelerator it shrinks to
+    a liveness check on the reference attention (the flash kernel would
+    run in Pallas interpret mode — correctness-only speed).
+    """
+    result: dict = {"failed": False}
+    try:
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        _enable_compile_cache(jax)
+        if os.environ.get(_FORCE_CPU_ENV):
+            jax.config.update("jax_platforms", "cpu")
+
+        device_kind = jax.devices()[0].device_kind
+        on_accel = jax.devices()[0].platform != "cpu"
+        result["platform"] = jax.devices()[0].platform
+        result["device"] = device_kind
+
+        from dss_ml_at_scale_tpu.models import TransformerLM, next_token_loss
+        from dss_ml_at_scale_tpu.utils.benchlib import timed_train_steps
+
+        if on_accel:
+            cfg = dict(vocab_size=8192, dim=1024, num_heads=8, num_layers=4,
+                       max_seq=2048, attention="flash", dtype=jnp.bfloat16)
+            batch, steps = 8, 10
+        else:
+            cfg = dict(vocab_size=128, dim=64, num_heads=4, num_layers=1,
+                       max_seq=256, attention="reference", dtype=jnp.float32)
+            batch, steps = 2, 2
+        seq = cfg["max_seq"]
+        result.update(
+            seq_len=seq, batch=batch, dim=cfg["dim"],
+            num_layers=cfg["num_layers"], attention=cfg["attention"],
+        )
+
+        model = TransformerLM(**cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(
+                0, cfg["vocab_size"], (batch, seq)
+            ),
+            jnp.int32,
+        )
+        params = model.init(jax.random.key(0), tokens)
+        tx = optax.adam(3e-4)
+        opt = tx.init(params)
+
+        def train_step(state, tokens):
+            params, opt = state
+            loss, grads = jax.value_and_grad(
+                lambda p: next_token_loss(model.apply(p, tokens), tokens)
+            )(params)
+            updates, opt = tx.update(grads, opt)
+            return (optax.apply_updates(params, updates), opt), {
+                "train_loss": loss
+            }
+
+        compiled = jax.jit(train_step, donate_argnums=0).lower(
+            (params, opt), tokens
+        ).compile()
+        flops_per_step = _xla_cost(compiled).get("flops_per_step", 0.0)
+
+        _, dt = timed_train_steps(compiled, (params, opt), tokens, steps)
+        tokens_per_sec = batch * seq * steps / dt
+        result["tokens_per_sec"] = round(tokens_per_sec, 1)
+        peak = PEAK_BF16_FLOPS.get(device_kind)
+        if flops_per_step and peak:
+            result["mfu"] = round(
+                flops_per_step * (tokens_per_sec / (batch * seq)) / peak, 4
+            )
+    except Exception:
+        result["failed"] = True
+        result["note"] = traceback.format_exc(limit=5)
+    print(json.dumps(result))
+
+
 def child_probe() -> None:
     """Claim the default backend and report it — nothing else. The parent
     uses this (under a short watchdog) to decide whether the accelerator
@@ -830,6 +945,8 @@ if __name__ == "__main__":
         mode = os.environ.get(_MODE_ENV)
         if mode == "group":
             child_group()
+        elif mode == "lm":
+            child_lm()
         elif mode == "probe":
             child_probe()
         else:
