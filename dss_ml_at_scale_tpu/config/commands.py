@@ -470,77 +470,51 @@ def _cmd_train(args: argparse.Namespace) -> int:
         if args.checkpoint_dir
         else None
     )
+    # One read; merged (not replaced) on rewrite so a resume whose --data
+    # table carries no labels.json keeps the persisted label_names.
+    meta = (
+        json.loads(meta_path.read_text())
+        if meta_path is not None and meta_path.exists()
+        else {}
+    )
     if args.torch_padding is not None:
         torch_padding = args.torch_padding
     elif args.pretrained:
         torch_padding = True
-    elif meta_path is not None and meta_path.exists():
-        torch_padding = bool(
-            json.loads(meta_path.read_text()).get("torch_padding", False)
-        )
     else:
-        torch_padding = False
-    # Same resolution for the LR schedule: the scheduled optimizer has a
-    # different opt_state STRUCTURE (ScaleByScheduleState count), so a
-    # flag-less --resume must rebuild what the checkpoint was trained
-    # with or the Orbax restore structure-mismatches.
-    if args.lr_schedule is not None:
-        lr_schedule = args.lr_schedule
-    elif meta_path is not None and meta_path.exists():
-        lr_schedule = json.loads(meta_path.read_text()).get(
-            "lr_schedule", "constant"
-        )
-    else:
-        lr_schedule = "constant"
+        torch_padding = bool(meta.get("torch_padding", False))
+    # Same steps/epoch arithmetic the Trainer uses (rows // global
+    # batch), so a fresh cosine trajectory matches the run length.
+    steps_per_epoch = rows // (args.batch_size * topo.process_count)
+    lr = _resolve_lr_schedule(
+        args, meta, total_steps=steps_per_epoch * args.epochs
+    )
+    meta.update(
+        torch_padding=torch_padding,
+        model=args.model,
+        num_classes=args.num_classes,
+        crop=args.crop,
+        fused_bn=args.fused_bn,
+    )
+    # Tables from dsst ingest carry their label vocabulary; persist
+    # it WITH the checkpoint (position = model output index), so
+    # predict names classes by the vocabulary the model was trained
+    # on — never by whatever table it later scores.
+    train_labels = Path(args.data) / "labels.json"
+    if train_labels.exists():
+        vocab = json.loads(train_labels.read_text())
+        names = [None] * args.num_classes
+        for name, idx in vocab.items():
+            if 0 <= int(idx) < args.num_classes:
+                names[int(idx)] = name
+        meta["label_names"] = names
     if meta_path is not None and topo.process_index == 0:
         meta_path.parent.mkdir(parents=True, exist_ok=True)
-        # Merge over any existing metadata: a resume whose --data table
-        # carries no labels.json must not silently drop the label_names
-        # persisted by the original training run.
-        meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
-        meta.update(
-            torch_padding=torch_padding,
-            model=args.model,
-            num_classes=args.num_classes,
-            crop=args.crop,
-            fused_bn=args.fused_bn,
-            lr_schedule=lr_schedule,
-        )
-        # Tables from dsst ingest carry their label vocabulary; persist
-        # it WITH the checkpoint (position = model output index), so
-        # predict names classes by the vocabulary the model was trained
-        # on — never by whatever table it later scores.
-        train_labels = Path(args.data) / "labels.json"
-        if train_labels.exists():
-            vocab = json.loads(train_labels.read_text())
-            names = [None] * args.num_classes
-            for name, idx in vocab.items():
-                if 0 <= int(idx) < args.num_classes:
-                    names[int(idx)] = name
-            meta["label_names"] = names
         meta_path.write_text(json.dumps(meta))
     model = _build_classifier_model(
         args.model, num_classes=args.num_classes, torch_padding=torch_padding,
         fused_bn=args.fused_bn,
     )
-    if lr_schedule == "cosine":
-        # Same steps/epoch arithmetic the Trainer uses (rows // global
-        # batch), so the decay horizon matches the actual run length.
-        steps_per_epoch = rows // (args.batch_size * topo.process_count)
-        total_steps = max(1, steps_per_epoch * args.epochs)
-        warmup = (
-            args.warmup_steps
-            if args.warmup_steps is not None
-            else max(1, total_steps // 20)
-        )
-        lr = optax.warmup_cosine_decay_schedule(
-            init_value=0.0,
-            peak_value=args.learning_rate,
-            warmup_steps=min(warmup, total_steps),
-            decay_steps=total_steps,
-        )
-    else:
-        lr = args.learning_rate
     task = ClassifierTask(model=model, tx=optax.adam(lr))
 
     init_state = None
@@ -845,6 +819,18 @@ def register_lm(sub: argparse._SubParsersAction) -> None:
     )
     lm.add_argument("--seed", type=int, default=0)
     lm.add_argument("--limit-val-batches", type=int, default=5)
+    lm.add_argument(
+        "--lr-schedule", choices=["constant", "cosine"], default=None,
+        help="cosine: linear warmup then cosine decay to 0 over the "
+        "run's total steps. Default: the value persisted in the "
+        "checkpoint dir (flag-less --resume keeps the trained "
+        "schedule's optimizer structure), else constant",
+    )
+    lm.add_argument(
+        "--warmup-steps", type=int, default=None,
+        help="warmup length for --lr-schedule cosine (default: 5%% of "
+        "total steps)",
+    )
     lm.add_argument("--checkpoint-dir", default=None)
     lm.add_argument("--resume", action="store_true")
     _add_tracking_args(lm, "lm")
@@ -895,9 +881,27 @@ def _cmd_lm(args: argparse.Namespace) -> int:
         expert_mesh=mesh if shard_experts else None,
         expert_axis="data",
     )
+    # Schedule trajectory persists beside the checkpoint and resolves
+    # exactly like dsst train's (shared _resolve_lr_schedule).
+    lm_meta_path = (
+        Path(args.checkpoint_dir) / "dsst_lm.json"
+        if args.checkpoint_dir
+        else None
+    )
+    lm_meta = (
+        json.loads(lm_meta_path.read_text())
+        if lm_meta_path is not None and lm_meta_path.exists()
+        else {}
+    )
+    lr = _resolve_lr_schedule(
+        args, lm_meta, total_steps=args.steps_per_epoch * args.epochs
+    )
+    if lm_meta_path is not None and topo.process_index == 0:
+        lm_meta_path.parent.mkdir(parents=True, exist_ok=True)
+        lm_meta_path.write_text(json.dumps(lm_meta))
     task = LMTask(
         model=model,
-        tx=optax.adam(args.learning_rate),
+        tx=optax.adam(lr),
         aux_loss_weight=args.aux_loss_weight if args.ffn == "moe" else 0.0,
     )
 
@@ -1161,6 +1165,55 @@ def _args_params(args: argparse.Namespace) -> dict:
     return {
         k: v for k, v in vars(args).items() if k not in skip and v is not None
     }
+
+
+def _resolve_lr_schedule(args: argparse.Namespace, meta: dict,
+                         total_steps: int):
+    """Resolve --lr-schedule/--warmup-steps against persisted metadata.
+
+    Returns the optax learning rate (float or schedule) and mutates
+    ``meta`` with the full trajectory (lr_schedule, warmup_steps,
+    decay_steps). A scheduled adam has a different opt_state STRUCTURE,
+    and the restored step count lands ON the schedule curve — so a
+    flag-less --resume must rebuild not just a schedule-shaped optimizer
+    but the SAME warmup/decay trajectory, or the LR would jump
+    discontinuously mid-run. Passing --lr-schedule explicitly redefines
+    the trajectory from the current invocation's run length.
+    """
+    explicit = args.lr_schedule is not None
+    schedule = args.lr_schedule if explicit else meta.get(
+        "lr_schedule", "constant"
+    )
+    if schedule != "cosine":
+        meta["lr_schedule"] = "constant"
+        meta.pop("warmup_steps", None)
+        meta.pop("decay_steps", None)
+        return args.learning_rate
+
+    import optax
+
+    if explicit or "decay_steps" not in meta:
+        decay = max(1, total_steps)
+        warmup = (
+            args.warmup_steps
+            if args.warmup_steps is not None
+            else max(1, decay // 20)
+        )
+    else:
+        decay = int(meta["decay_steps"])
+        warmup = (
+            args.warmup_steps
+            if args.warmup_steps is not None
+            else int(meta.get("warmup_steps", max(1, decay // 20)))
+        )
+    warmup = min(warmup, decay)
+    meta.update(lr_schedule="cosine", warmup_steps=warmup, decay_steps=decay)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=args.learning_rate,
+        warmup_steps=warmup,
+        decay_steps=decay,
+    )
 
 
 def _finish_tracker(tracker, params: dict | None = None,
