@@ -87,6 +87,17 @@ def _f64_loglike(
         P = T @ P @ T.T + RQR
         v = w[t] - Z @ a
         F = max(float(Z @ P @ Z), 1e-300)
+        if (
+            not np.isfinite(v)
+            or F > 1e280
+            or (abs(v) > 1.0 and 2.0 * np.log(abs(v)) - np.log(F) > 700.0)
+        ):
+            # A diverged candidate (explosive AR draw): reject it
+            # outright instead of letting v*v/F overflow into inf/nan
+            # arithmetic (nan would also confuse Nelder-Mead's ordering,
+            # where -inf sorts cleanly worst). The log-space check bounds
+            # v²/F below the float64 overflow threshold.
+            return -np.inf
         ll += -0.5 * (log2pi + np.log(F) + v * v / F)
         K = P @ Z / F
         a = a + K * v
