@@ -90,6 +90,8 @@ def _f64_loglike(
         if (
             not np.isfinite(v)
             or F > 1e280
+            or abs(v) > 1e150  # v*v itself must not overflow...
+            # ...and neither may the ratio v²/F (tiny-F case).
             or (abs(v) > 1.0 and 2.0 * np.log(abs(v)) - np.log(F) > 700.0)
         ):
             # A diverged candidate (explosive AR draw): reject it
