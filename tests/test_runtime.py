@@ -73,3 +73,56 @@ def test_psum_over_data_axis(devices8):
 
     out = global_mean(x)
     np.testing.assert_allclose(np.asarray(out), np.ones(2))
+
+
+def test_shard_batch_specs_validation(devices8):
+    # The batch_specs override path must fail with the same clear
+    # ValueError discipline as the default path: unknown mesh axis,
+    # indivisible sharded dim — not an opaque XLA error downstream.
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from dss_ml_at_scale_tpu.runtime.mesh import make_mesh, shard_batch_to_mesh
+
+    mesh = make_mesh({"data": 2, "sp": 4})
+    ok = shard_batch_to_mesh(
+        {"tokens": np.ones((8, 16, 3))}, mesh, axis="data",
+        specs={"tokens": P(None, "sp")},
+    )
+    assert ok["tokens"].shape == (8, 16, 3)
+    # Tuple-axis specs shard by the product of the named axes.
+    ok2 = shard_batch_to_mesh(
+        {"t": np.ones((16, 8))}, mesh, axis="data",
+        specs={"t": P(("data", "sp"), None)},
+    )
+    assert ok2["t"].shape == (16, 8)
+
+    with pytest.raises(ValueError, match="not in mesh axes"):
+        shard_batch_to_mesh(
+            {"tokens": np.ones((8, 16))}, mesh, axis="data",
+            specs={"tokens": P(None, "bogus")},
+        )
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_batch_to_mesh(
+            {"tokens": np.ones((8, 6))}, mesh, axis="data",
+            specs={"tokens": P(None, "sp")},
+        )
+
+
+def test_check_same_mesh_rejects_reordered_devices(devices8):
+    # Equal axis sizes are NOT enough: a different device assignment
+    # would place state on one mesh while shard_map runs over another.
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from dss_ml_at_scale_tpu.parallel.pipeline import check_same_mesh
+
+    devs = np.array(jax.devices()).reshape(2, 4)
+    m1 = Mesh(devs, ("pipe", "data"))
+    check_same_mesh(m1, m1, "X")  # identity
+    check_same_mesh(m1, Mesh(devs, ("pipe", "data")), "X")  # equal devices
+    with pytest.raises(ValueError, match="construct the task"):
+        check_same_mesh(m1, Mesh(devs[::-1], ("pipe", "data")), "X")
+    with pytest.raises(ValueError, match="construct the task"):
+        check_same_mesh(m1, Mesh(devs.reshape(4, 2), ("pipe", "data")), "X")
