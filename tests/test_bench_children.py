@@ -1,0 +1,54 @@
+"""Bench harness children as subprocesses (the driver-facing surface).
+
+The driver runs ``python bench.py`` and consumes one JSON line; the
+parent/watchdog logic is exercised against a possibly-hung tunnel in
+production, so what CI can and should pin is the CHILD contract: each
+child prints exactly one parseable JSON object on stdout and honors the
+forced-CPU env. The heavyweight train child is covered by the slow CLI
+and end-to-end suites; probe and lm are cheap enough to run here.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_child(mode: str, timeout: float):
+    env = dict(
+        os.environ,
+        DSST_BENCH_CHILD="1",
+        DSST_BENCH_MODE=mode,
+        DSST_BENCH_FORCE_CPU="1",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = proc.stdout.strip().splitlines()
+    assert lines, "child printed nothing"
+    return json.loads(lines[-1])
+
+
+def test_probe_child_reports_platform():
+    out = _run_child("probe", timeout=120)
+    assert out.get("platform") == "cpu"
+    assert out.get("n", 0) >= 1
+    assert not out.get("failed")
+
+
+@pytest.mark.slow
+def test_lm_child_measures_tokens_per_sec():
+    out = _run_child("lm", timeout=420)
+    assert not out.get("failed"), out.get("note")
+    assert out["platform"] == "cpu"
+    assert out["tokens_per_sec"] > 0
+    # CPU fallback shape: reference attention, shrunk geometry.
+    assert out["attention"] == "reference"
+    assert out["seq_len"] == 256
