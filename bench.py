@@ -928,6 +928,11 @@ def child_probe() -> None:
         import jax
 
         _enable_compile_cache(jax)
+        if os.environ.get(_FORCE_CPU_ENV):
+            # The parent never forces CPU on a probe (its whole job is to
+            # reach the accelerator); this is the test harness's handle
+            # for exercising the child's JSON contract hermetically.
+            jax.config.update("jax_platforms", "cpu")
         dev = jax.devices()[0]
         # One tiny dispatch proves the device executes, not just enumerates.
         import jax.numpy as jnp
