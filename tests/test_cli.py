@@ -700,3 +700,51 @@ def test_lm_cli_cosine_schedule_resume(tmp_path, capsys, devices8):
     assert main(common + ["--epochs", "2", "--resume"]) == 0
     s2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert s2["steps"] == 20
+
+
+def test_resolve_lr_schedule_precedence():
+    # Pure-logic unit test of the shared resolution: explicit flag
+    # redefines the trajectory; omitted flag reuses the persisted one
+    # bit-for-bit; constant clears persisted trajectory keys.
+    import argparse
+
+    from dss_ml_at_scale_tpu.config.commands import _resolve_lr_schedule
+
+    def ns(schedule=None, warmup=None, lr=0.01):
+        return argparse.Namespace(
+            lr_schedule=schedule, warmup_steps=warmup, learning_rate=lr
+        )
+
+    # Fresh explicit cosine: trajectory derived from this run.
+    meta = {}
+    lr = _resolve_lr_schedule(ns("cosine"), meta, total_steps=100)
+    assert callable(lr)
+    assert meta == {"lr_schedule": "cosine", "warmup_steps": 5,
+                    "decay_steps": 100}
+
+    # Flag-less resume with a DIFFERENT run length: persisted trajectory
+    # wins (the restored step count sits on the original curve).
+    meta2 = dict(meta)
+    lr2 = _resolve_lr_schedule(ns(None), meta2, total_steps=999)
+    assert callable(lr2)
+    assert meta2["decay_steps"] == 100 and meta2["warmup_steps"] == 5
+    # Same curve numerically, not just same keys.
+    assert float(lr(50)) == pytest.approx(float(lr2(50)))
+
+    # Explicit re-declaration redefines from the new run length.
+    meta3 = dict(meta)
+    _resolve_lr_schedule(ns("cosine"), meta3, total_steps=200)
+    assert meta3["decay_steps"] == 200 and meta3["warmup_steps"] == 10
+
+    # Explicit warmup override on a persisted trajectory keeps decay.
+    meta4 = dict(meta)
+    _resolve_lr_schedule(ns(None, warmup=1), meta4, total_steps=999)
+    assert meta4 == {"lr_schedule": "cosine", "warmup_steps": 1,
+                     "decay_steps": 100}
+
+    # constant (default with no persisted state) returns the float and
+    # clears any stale trajectory keys.
+    meta5 = dict(meta)
+    lr5 = _resolve_lr_schedule(ns("constant"), meta5, total_steps=50)
+    assert lr5 == 0.01
+    assert meta5 == {"lr_schedule": "constant"}
