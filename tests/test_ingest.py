@@ -173,3 +173,38 @@ def test_append_continues_id_sequence(image_tree, tmp_path):
         i for uri in table.file_uris() for i in pq.read_table(uri)["id"].to_pylist()
     )
     assert ids == list(range(12))  # unique, contiguous across both ingests
+
+
+def test_ingest_append_continues_label_vocabulary(image_tree, tmp_path):
+    # Append of a tree with one NEW class: existing assignments must not
+    # renumber (labels.json reloads), the new class extends the vocab,
+    # and ids continue monotonically.
+    import shutil
+
+    table_path = tmp_path / "grow.delta"
+    ingest_image_dataset(image_tree / "Data", table_path)
+    vocab1 = json.loads((table_path / "labels.json").read_text())
+
+    extra_root = tmp_path / "extra" / "Data" / "n99999999"
+    extra_root.mkdir(parents=True)
+    src = image_tree / "Data" / "n01440764" / "n01440764_0.JPEG"
+    shutil.copy(src, extra_root / "n99999999_0.JPEG")
+    table = ingest_image_dataset(
+        tmp_path / "extra" / "Data", table_path, mode="append"
+    )
+    vocab2 = json.loads((table_path / "labels.json").read_text())
+    for name, idx in vocab1.items():
+        assert vocab2[name] == idx  # no renumbering
+    assert vocab2["n99999999"] == len(vocab1)
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    full = pa.concat_tables(
+        [pq.read_table(u) for u in table.file_uris()]
+    ).sort_by("id")
+    assert full["id"].to_pylist() == list(range(13))  # 12 + 1 appended
+    by_object = dict(
+        zip(full["object_id"].to_pylist(), full["label_index"].to_pylist())
+    )
+    assert by_object["n99999999"] == len(vocab1)
