@@ -80,6 +80,36 @@ def synthetic_image_batch(batch: int, image: int, num_classes: int,
     }
 
 
+def synthetic_image_batch_device(batch: int, image: int, num_classes: int,
+                                 seed: int = 0) -> dict:
+    """Device-resident synthetic batch, generated ON the device.
+
+    The host-numpy path (``synthetic_image_batch`` + ``device_put``)
+    ships ~127 MB through the accelerator tunnel at batch 212; a
+    degraded tunnel has been observed to stall exactly there (round-4
+    live run: train_step compiled in ~3 min, then 12 min with no
+    progress).  Generating the batch with on-device PRNG removes bulk
+    host->device traffic from the compute-path benchmark entirely —
+    which is also the honest shape of the metric: it measures the chip,
+    not the tunnel.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # Eager (un-jitted) on purpose: a fresh jit closure per call would
+    # guarantee a cache-miss compile per sweep point; eager PRNG ops
+    # compile nothing extra and still run on the default device.
+    ki, kl = jax.random.split(jax.random.key(seed))
+    out = {
+        "image": jax.random.normal(ki, (batch, image, image, 3),
+                                   jnp.float32),
+        "label": jax.random.randint(kl, (batch,), 0, num_classes,
+                                    jnp.int32),
+    }
+    jax.block_until_ready(out)
+    return out
+
+
 def timed_train_steps(step_fn, state, batch, steps: int,
                       loss_key: str = "train_loss", warmup: int = 2):
     """(state, seconds) for ``steps`` chained calls after ``warmup``.
@@ -89,7 +119,8 @@ def timed_train_steps(step_fn, state, batch, steps: int,
     """
     for _ in range(warmup):
         state, metrics = step_fn(state, batch)
-    float(metrics[loss_key])
+    if warmup:
+        float(metrics[loss_key])
 
     t0 = time.perf_counter()
     for _ in range(steps):

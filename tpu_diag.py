@@ -1,0 +1,124 @@
+"""Staged tunnel diagnostic: find WHERE on-chip bench time goes.
+
+The round-4 live bench attempts compiled the batch-212 train_step in
+~3 min (23.5 MB executable cached at 03:51:08) and then produced
+nothing for the remaining 12 min of watchdog budget.  Hypothesis: the
+host->device transfer of the 127 MB float32 synthetic batch
+(`jax.device_put(host_batch)` in bench._bench_compute_at) is orders of
+magnitude slower through today's tunnel than the round-3 tunnel.
+
+This script prints a timestamped line after EVERY stage, flushing, so
+a watchdog kill still leaves a complete record of the last stage that
+finished.  Stages: import, claim, tiny dispatch, host->device transfer
+at 1/8/32/128 MB, device->host fetch at 1/8 MB, on-device batch
+generation (the zero-transfer alternative), ResNet-50 init (device),
+train_step compile (should hit the persistent cache), first execution,
+10 timed steps.
+
+Usage:  timeout 1800 python tpu_diag.py [--skip-transfers]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+T0 = time.perf_counter()
+
+
+def mark(msg: str) -> None:
+    print(f"[{time.perf_counter() - T0:8.1f}s] {msg}", flush=True)
+
+
+def main() -> None:
+    skip_transfers = "--skip-transfers" in sys.argv
+
+    mark("importing jax")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _enable_compile_cache
+
+    _enable_compile_cache(jax)
+    mark("jax imported")
+
+    devs = jax.devices()
+    mark(f"devices claimed: {devs}")
+
+    x = jnp.ones((8, 128), jnp.float32)
+    (x @ x.T).block_until_ready()
+    mark("tiny dispatch ok")
+
+    if not skip_transfers:
+        for mb in (1, 8, 32, 128):
+            host = np.random.default_rng(0).normal(
+                size=(mb * 1024 * 1024 // 4,)
+            ).astype(np.float32)
+            t = time.perf_counter()
+            dev = jax.device_put(host)
+            dev.block_until_ready()
+            dt = time.perf_counter() - t
+            mark(f"h2d {mb:4d} MB: {dt:7.2f}s  ({mb / dt:8.2f} MB/s)")
+            if dt > 120:
+                mark("h2d too slow; skipping larger sizes")
+                break
+        for mb in (1, 8):
+            dev = jnp.zeros((mb * 1024 * 1024 // 4,), jnp.float32) + 1.0
+            dev.block_until_ready()
+            t = time.perf_counter()
+            _ = np.asarray(dev)
+            dt = time.perf_counter() - t
+            mark(f"d2h {mb:4d} MB: {dt:7.2f}s  ({mb / dt:8.2f} MB/s)")
+
+    # On-device batch generation: the zero-transfer path.
+    batch, image = 212, 224
+
+    @jax.jit
+    def make_batch(key):
+        ki, kl = jax.random.split(key)
+        return {
+            "image": jax.random.normal(
+                ki, (batch, image, image, 3), jnp.float32
+            ),
+            "label": jax.random.randint(kl, (batch,), 0, 1000, jnp.int32),
+        }
+
+    t = time.perf_counter()
+    device_batch = make_batch(jax.random.key(0))
+    jax.block_until_ready(device_batch)
+    mark(f"on-device batch gen (compile+run): {time.perf_counter() - t:.2f}s")
+
+    from dss_ml_at_scale_tpu.utils.benchlib import build_resnet_task
+
+    task = build_resnet_task(num_classes=1000, on_accel=True)
+    mark("task built")
+
+    t = time.perf_counter()
+    state = task.init_state(jax.random.key(0), device_batch)
+    jax.block_until_ready(state.params)
+    mark(f"init_state: {time.perf_counter() - t:.2f}s")
+
+    t = time.perf_counter()
+    compiled = jax.jit(task.train_step, donate_argnums=0).lower(
+        state, device_batch
+    ).compile()
+    mark(f"train_step compile: {time.perf_counter() - t:.2f}s")
+
+    t = time.perf_counter()
+    state, metrics = compiled(state, device_batch)
+    loss = float(metrics["train_loss"])
+    mark(f"first step (exec+fetch): {time.perf_counter() - t:.2f}s "
+         f"loss={loss:.3f}")
+
+    t = time.perf_counter()
+    steps = 10
+    for _ in range(steps):
+        state, metrics = compiled(state, device_batch)
+    float(metrics["train_loss"])
+    dt = time.perf_counter() - t
+    mark(f"{steps} steps: {dt:.2f}s -> {batch * steps / dt:.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()
