@@ -703,10 +703,8 @@ def child_train() -> None:
                  if "images_per_sec" in p]
         done_batches = {p.get("batch") for p in sweep}
         best = None  # (ips, batch, train_step_or_None)
-        for p in sweep:
-            if "images_per_sec" in p and (
-                best is None or p["images_per_sec"] > best[0]
-            ):
+        for p in sweep:  # every entry is a successful point (filter above)
+            if best is None or p["images_per_sec"] > best[0]:
                 best = (p["images_per_sec"], p["batch"], None)
         t_start = time.perf_counter()
         for bs in batches:
@@ -854,8 +852,9 @@ def child_train() -> None:
                     top = _profile_top_categories(
                         jax, train_step, task, best_batch, image, tmpdir
                     )
-                    if top:
-                        result["profile"] = {"top_hlo_categories": top}
+                    # Empty success still marks the section done, or a
+                    # resumed attempt repeats the trace run for nothing.
+                    result["profile"] = {"top_hlo_categories": top or []}
                 except Exception:
                     result["profile"] = {"error": traceback.format_exc(limit=3)}
                 _save_partial(result)

@@ -124,6 +124,71 @@ def main() -> int:
             transform_spec=spec, shuffle_row_groups=False,
         ).__enter__()
 
+    def build_artifact(history, *, complete: bool, best_ckpt=None) -> dict:
+        curve = [
+            {
+                "epoch": h["epoch"],
+                "train_loss": round(h.get("train_loss", float("nan")), 4),
+                "val_acc": round(h.get("val_acc", float("nan")), 4),
+                "images_per_sec": round(h.get("images_per_sec", 0.0), 1),
+            }
+            for h in history
+        ]
+        final_acc = curve[-1]["val_acc"] if curve else 0.0
+        best_acc = max((c["val_acc"] for c in curve), default=0.0)
+        out = {
+            "device": jax.devices()[0].device_kind,
+            "classes": args.classes,
+            "n_train": args.n_train,
+            "n_val": args.n_val,
+            "epochs_run": len(curve),
+            "complete": complete,
+            "curve": curve,
+            "final_val_acc": final_acc,
+            "best_val_acc": best_acc,
+            "best_checkpoint": best_ckpt,
+            "wall_seconds": round(time.time() - t_start, 1),
+        }
+        if args.label_noise > 0:
+            # The discriminating regime: best achievable val_acc is
+            # exactly the noise ceiling. Passing requires landing IN the
+            # band — too low is a training regression, above the ceiling
+            # + sampling slack means the eval itself is broken (e.g.
+            # leaking labels).
+            ceiling = (
+                (1.0 - args.label_noise) + args.label_noise / args.classes
+            )
+            # 512-sample binomial std at the ceiling is ~0.017; 0.05 of
+            # upward slack is ~3 sigma, 0.10 down tolerates a slow epoch.
+            band = [round(ceiling - 0.10, 4),
+                    round(min(1.0, ceiling + 0.05), 4)]
+            out.update(
+                label_noise=args.label_noise,
+                acc_ceiling=round(ceiling, 4),
+                pinned_band=band,
+                reached_target=bool(band[0] <= best_acc <= band[1]),
+            )
+        else:
+            out.update(target=args.target,
+                       reached_target=best_acc >= args.target)
+        return out
+
+    def write_artifact(out: dict) -> None:
+        # Atomic (tmp + rename): a watchdog kill mid-write must leave
+        # the previous complete artifact, not a truncated JSON.
+        tmp = Path(args.out + ".tmp")
+        tmp.write_text(json.dumps(out, indent=1))
+        tmp.replace(args.out)
+
+    history: list[dict] = []
+
+    def on_epoch(summary: dict) -> None:
+        # Checkpoint the artifact after EVERY epoch (complete=false): a
+        # watchdog kill or tunnel stall mid-run still leaves the curve
+        # measured so far on disk instead of nothing.
+        history.append(summary)
+        write_artifact(build_artifact(history, complete=False))
+
     with batch_loader(
         workdir / "train",
         batch_size=args.batch_size,
@@ -132,52 +197,15 @@ def main() -> int:
         results_queue_size=8,
         transform_spec=spec,
     ) as reader:
-        result = trainer.fit(task, reader, val_data_factory=val_factory)
+        result = trainer.fit(task, reader, val_data_factory=val_factory,
+                             epoch_callback=on_epoch)
     store.finish()
 
-    curve = [
-        {
-            "epoch": h["epoch"],
-            "train_loss": round(h.get("train_loss", float("nan")), 4),
-            "val_acc": round(h.get("val_acc", float("nan")), 4),
-            "images_per_sec": round(h.get("images_per_sec", 0.0), 1),
-        }
-        for h in result.history
-    ]
-    final_acc = curve[-1]["val_acc"] if curve else 0.0
-    best_acc = max((c["val_acc"] for c in curve), default=0.0)
-    out = {
-        "device": jax.devices()[0].device_kind,
-        "classes": args.classes,
-        "n_train": args.n_train,
-        "n_val": args.n_val,
-        "epochs_run": len(curve),
-        "curve": curve,
-        "final_val_acc": final_acc,
-        "best_val_acc": best_acc,
-        "best_checkpoint": result.best_checkpoint_path,
-        "wall_seconds": round(time.time() - t_start, 1),
-    }
-    if args.label_noise > 0:
-        # The discriminating regime: best achievable val_acc is exactly
-        # the noise ceiling. Passing requires landing IN the band — too
-        # low is a training regression, above the ceiling + sampling
-        # slack means the eval itself is broken (e.g. leaking labels).
-        ceiling = (1.0 - args.label_noise) + args.label_noise / args.classes
-        # 512-sample binomial std at the ceiling is ~0.017; 0.05 of
-        # upward slack is ~3 sigma, 0.10 down tolerates a slow epoch.
-        band = [round(ceiling - 0.10, 4), round(min(1.0, ceiling + 0.05), 4)]
-        out.update(
-            label_noise=args.label_noise,
-            acc_ceiling=round(ceiling, 4),
-            pinned_band=band,
-            reached_target=bool(band[0] <= best_acc <= band[1]),
-        )
-    else:
-        out.update(target=args.target, reached_target=best_acc >= args.target)
-    Path(args.out).write_text(json.dumps(out, indent=1))
+    out = build_artifact(result.history, complete=True,
+                         best_ckpt=result.best_checkpoint_path)
+    write_artifact(out)
     print(json.dumps({k: v for k, v in out.items() if k != "curve"}))
-    for c in curve:
+    for c in out["curve"]:
         print(f"  epoch {c['epoch']}: val_acc {c['val_acc']}", flush=True)
     return 0 if out["reached_target"] else 1
 

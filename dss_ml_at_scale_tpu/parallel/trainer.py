@@ -340,7 +340,15 @@ class Trainer:
         *,
         rng: jax.Array | None = None,
         state: TrainState | None = None,
+        epoch_callback: Callable[[dict], None] | None = None,
     ) -> FitResult:
+        """``epoch_callback`` (if given) receives a copy of each epoch's
+        summary dict right after it is appended to the history — the
+        Lightning-callback seam (reference trains under
+        ``pl.Trainer(...callbacks=...)``,
+        ``deep_learning/2...py:190-208``) for progress artifacts,
+        early-stop bookkeeping, or external monitors. Exceptions
+        propagate: a broken callback should fail the run loudly."""
         # Resolve task-default best metric into a LOCAL cfg only — the same
         # Trainer may fit different task types, so self.config must keep
         # its None sentinels.
@@ -485,6 +493,8 @@ class Trainer:
             self._log(
                 {k: v for k, v in epoch_summary.items() if k != "epoch"}, step
             )
+            if epoch_callback is not None:
+                epoch_callback(dict(epoch_summary))
 
             metric_val = epoch_summary.get(cfg.best_metric)
             is_best = metric_val is not None and (

@@ -73,7 +73,10 @@ def test_train_child_checkpoints_partial_and_resumes(tmp_path):
     assert saved["platform"] == "cpu"
     assert saved["value"] > 0
     assert any("images_per_sec" in p for p in saved["sweep"])
-    assert "pipeline" in saved  # (profile is absent on cpu: no TPU events)
+    assert "pipeline" in saved
+    # Empty-success profile still marks the section done (cpu traces
+    # carry no TPU events, so the category list is empty).
+    assert saved["profile"] == {"top_hlo_categories": []}
 
     # Poison the saved throughput: a resumed run must REUSE the sweep
     # point (proving it skipped re-measurement) and not recompute it.
@@ -86,7 +89,10 @@ def test_train_child_checkpoints_partial_and_resumes(tmp_path):
     out2 = _run_child("train", timeout=600, partial_path=str(partial))
     assert not out2.get("failed"), out2.get("note")
     assert out2["value"] == 12345.0
-    assert out2["pipeline"] == out1["pipeline"]
+    if "error" not in out1["pipeline"]:
+        # An errored section is deliberately NOT treated as done (the
+        # resume re-runs it), so byte-equality only holds for a clean one.
+        assert out2["pipeline"] == out1["pipeline"]
 
 
 def test_parent_salvages_partial_over_cpu_fallback(tmp_path):

@@ -36,10 +36,21 @@ def test_loss_decreases_on_learnable_task(devices8, task):
         TrainerConfig(max_epochs=2, steps_per_epoch=20, log_every_steps=1000),
         mesh=mesh,
     )
-    result = trainer.fit(task, iter(batches))
+    # The Lightning-callback seam: one call per epoch, summaries are
+    # copies (mutating them must not corrupt the returned history).
+    seen: list[dict] = []
+
+    def on_epoch(summary):
+        seen.append(summary)
+        summary["epoch"] = -999
+
+    result = trainer.fit(task, iter(batches), epoch_callback=on_epoch)
     assert len(result.history) == 2
     assert result.history[1]["train_loss"] < result.history[0]["train_loss"]
     assert result.history[1]["train_acc"] > 0.5
+    assert [s["epoch"] for s in seen] == [-999, -999]
+    assert [h["epoch"] for h in result.history] == [0, 1]
+    assert seen[0]["train_loss"] == result.history[0]["train_loss"]
 
 
 @pytest.mark.slow

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Probe the accelerator tunnel; on the first successful claim run the
+# full on-chip artifact chain, then exit.  Order: bench (headline, now
+# checkpoint/resume-hardened) -> accuracy -> scaling refresh -> 2-device
+# smoke -> staged diag last (its bulk transfers are the likeliest to
+# stall, and a stall then costs nothing downstream).
+cd "$(dirname "$0")"
+while true; do
+  echo "$(date -u +%H:%M:%S) probe" >> tpu_watchdog.log
+  timeout 150 python - >> tpu_watchdog.log 2>&1 <<'PY'
+import jax
+d = jax.devices()[0]
+assert d.platform != "cpu"
+import jax.numpy as jnp
+jnp.zeros((8, 8)).sum().block_until_ready()
+print("CLAIM OK", d.platform, d.device_kind, flush=True)
+PY
+  if [ $? -eq 0 ]; then
+    echo "$(date -u +%H:%M:%S) tunnel up -> bench" >> tpu_watchdog.log
+    sleep 10
+    DSST_BENCH_TIMEOUT=2400 DSST_BENCH_GROUP_TIMEOUT=1500 DSST_BENCH_LM_TIMEOUT=1200 \
+      timeout 10800 python bench.py > BENCH_onchip_r4.json 2> bench_onchip_stderr.log
+    echo "$(date -u +%H:%M:%S) bench rc=$?" >> tpu_watchdog.log
+    timeout 2400 python bench_accuracy.py --out ACCURACY_onchip_r4.json >> tpu_watchdog.log 2>&1
+    echo "$(date -u +%H:%M:%S) accuracy rc=$?" >> tpu_watchdog.log
+    timeout 900 python scaling_model.py --bench-json BENCH_onchip_r4.json >> tpu_watchdog.log 2>&1
+    echo "$(date -u +%H:%M:%S) scaling rc=$?" >> tpu_watchdog.log
+    timeout 600 python smoke_two_device_trials.py >> tpu_watchdog.log 2>&1
+    echo "$(date -u +%H:%M:%S) 2dev smoke rc=$?" >> tpu_watchdog.log
+    timeout 1800 python tpu_diag.py > tpu_diag_live.log 2>&1
+    echo "$(date -u +%H:%M:%S) diag rc=$? - chain complete" >> tpu_watchdog.log
+    break
+  fi
+  sleep 700
+done
