@@ -373,7 +373,11 @@ def register_train(sub: argparse._SubParsersAction) -> None:
     )
     tr.add_argument("--num-classes", type=int, default=1000)
     tr.add_argument("--crop", type=int, default=224)
-    tr.add_argument("--model", choices=["resnet50", "tiny"], default="resnet50")
+    tr.add_argument(
+        "--model",
+        choices=["resnet50", "tiny", "vit-t16", "vit-s16", "vit-tiny"],
+        default="resnet50",
+    )
     tr.add_argument(
         "--pretrained", default=None, metavar="PATH",
         help="torchvision-layout state dict (.pt/.pth/.npz) to fine-tune "
@@ -442,6 +446,12 @@ def register_train(sub: argparse._SubParsersAction) -> None:
 
 def _cmd_train(args: argparse.Namespace) -> int:
     import optax
+
+    if args.pretrained and args.model.startswith("vit"):
+        raise SystemExit(
+            "--pretrained converts torchvision ResNet layouts; there is "
+            "no ViT converter yet (train --model vit-* from scratch)"
+        )
 
     from ..data import DeltaTable, batch_loader
     from ..data.transform import imagenet_transform_spec
@@ -616,7 +626,20 @@ def _has_checkpoint(args: argparse.Namespace) -> bool:
 
 def _build_classifier_model(name: str, *, num_classes: int,
                             torch_padding: bool, fused_bn: bool = True):
-    """The train/predict-shared model factory ("resnet50" | "tiny")."""
+    """The train/predict-shared model factory
+    ("resnet50" | "tiny" | "vit-t16" | "vit-s16" | "vit-tiny")."""
+    if name.startswith("vit"):
+        # torch_padding / fused_bn are conv/BN concepts; a ViT has
+        # neither, so the flags are inert for these choices.
+        from ..models import ViT, vit_s16, vit_t16
+
+        if name == "vit-t16":
+            return vit_t16(num_classes)
+        if name == "vit-s16":
+            return vit_s16(num_classes)
+        # "vit-tiny": a CI-sized geometry (patch 8 suits small crops).
+        return ViT(num_classes=num_classes, patch=8, dim=32, depth=2,
+                   num_heads=2)
     from ..models import ResNet50
 
     if name == "resnet50":
@@ -676,6 +699,20 @@ def _cmd_predict(args: argparse.Namespace) -> int:
         return 1
     meta = json.loads(meta_path.read_text())
     crop = args.crop or int(meta.get("crop", 224))
+    if (
+        str(meta.get("model", "")).startswith("vit")
+        and meta.get("crop")
+        and crop != int(meta["crop"])
+    ):
+        # A ViT's position table is sized by the training crop; a
+        # different scoring crop would fail deep in the orbax restore
+        # with a raw structure mismatch. (ResNet pools globally and
+        # tolerates the override.)
+        raise SystemExit(
+            f"--crop {crop} differs from the training crop "
+            f"{meta['crop']}: ViT checkpoints must be scored at the "
+            "crop they were trained with"
+        )
     model = _build_classifier_model(
         meta.get("model", "resnet50"),
         num_classes=int(meta["num_classes"]),
@@ -722,12 +759,14 @@ def _cmd_predict(args: argparse.Namespace) -> int:
                 # (the structure-matched restore still had to read it).
                 params, batch_stats = state.params, state.batch_stats
                 state = None
+                variables = {"params": params}
+                if batch_stats:  # stat-free models (ViT) have none
+                    variables["batch_stats"] = batch_stats
 
                 @jax.jit
                 def predict(batch):
                     logits = model.apply(
-                        {"params": params, "batch_stats": batch_stats},
-                        task._images(batch), train=False,
+                        variables, task._images(batch), train=False,
                     )
                     probs = jax.nn.softmax(logits.astype("float32"), axis=-1)
                     return jnp.argmax(probs, axis=-1), jnp.max(probs, axis=-1)

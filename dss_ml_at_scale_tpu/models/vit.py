@@ -1,0 +1,161 @@
+"""Vision Transformer classifier — the second image-model family.
+
+The reference's deep-learning track fine-tunes torchvision classifiers
+(``deep_learning/2.distributed-data-loading-petastorm.py:150`` pins
+ResNet-50, with the rest of the torchvision zoo one import away); this
+module provides the transformer half of that zoo, built TPU-first:
+
+- **Patchify as one convolution**: a stride-``patch`` conv lowers to a
+  single big MXU matmul over NHWC input (the same layout the decode
+  pipeline emits) — no im2col, no per-patch gather.
+- **Everything after patchify is matmuls**: pre-LN encoder blocks whose
+  attention and MLP are einsums XLA tiles straight onto the MXU in
+  bf16; no BatchNorm anywhere, so there is no cross-batch state, no
+  sync-BN collective, and the DP/TP shardings of the classifier track
+  apply unchanged (``ClassifierTask`` handles the empty ``batch_stats``
+  collection).
+- **Static shapes throughout**: sequence length is fixed by
+  ``image/patch`` at init; the CLS token and learned position table are
+  ordinary parameters.
+
+Geometry presets mirror the standard ViT family (ViT-Ti/16, ViT-S/16)
+at any crop divisible by the patch size.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class ViTBlock(nn.Module):
+    """Pre-LN encoder block: LN → MHA → residual, LN → MLP → residual.
+
+    Attention is bidirectional (no causal mask — images, not text),
+    computed by ``ops.flash_attention.attention_reference`` — the same
+    helper the LM stack's Pallas kernel is verified against, so the
+    attention numerics live in exactly one place.
+    """
+
+    dim: int
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # [b, n, dim]
+        head_dim = self.dim // self.num_heads
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, dtype=self.dtype, name=name
+        )
+
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
+        q = dense(self.dim, "q")(h)
+        k = dense(self.dim, "k")(h)
+        v = dense(self.dim, "v")(h)
+
+        def heads(t):  # [b, n, dim] -> [b, heads, n, head_dim]
+            b, n, _ = t.shape
+            return t.reshape(b, n, self.num_heads, head_dim).transpose(
+                0, 2, 1, 3
+            )
+
+        from ..ops.flash_attention import attention_reference
+
+        q, k, v = heads(q), heads(k), heads(v)
+        # Bidirectional (causal=False) — images, not text; same helper
+        # as the LM family, so attention numerics live in ONE place.
+        out = attention_reference(q, k, v, causal=False)
+        b, _, n, _ = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, self.dim)
+        x = x + dense(self.dim, "attn_out")(out)
+
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
+        h = dense(self.dim * self.mlp_ratio, "mlp_in")(h)
+        h = nn.gelu(h)
+        return x + dense(self.dim, "mlp_out")(h)
+
+
+class ViT(nn.Module):
+    """Vision Transformer over NHWC images.
+
+    ``__call__(images, train=...)`` matches the ``ClassifierTask``
+    model contract (``parallel/trainer.py``); ``train`` is accepted for
+    interface parity — the architecture is deterministic (no dropout,
+    no batch statistics), which is also what makes it embarrassingly
+    shardable.
+    """
+
+    num_classes: int
+    patch: int = 16
+    dim: int = 192
+    depth: int = 12
+    num_heads: int = 3
+    mlp_ratio: int = 4
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):  # [b, h, w, 3] NHWC
+        b, h, w, _ = x.shape
+        if h % self.patch or w % self.patch:
+            raise ValueError(
+                f"image {h}x{w} not divisible by patch {self.patch}"
+            )
+        x = x.astype(self.dtype)
+        # Patchify: one stride-p conv == one MXU matmul over NHWC.
+        x = nn.Conv(
+            self.dim,
+            kernel_size=(self.patch, self.patch),
+            strides=(self.patch, self.patch),
+            padding="VALID",
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        n = (h // self.patch) * (w // self.patch)
+        x = x.reshape(b, n, self.dim)
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, self.dim), jnp.float32
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, self.dim)), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, n + 1, self.dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+
+        for i in range(self.depth):
+            x = ViTBlock(
+                dim=self.dim,
+                num_heads=self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(x)
+
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
+        # Classify from the CLS token; logits in f32 for a stable loss.
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
+            x[:, 0]
+        )
+
+
+def vit_t16(num_classes: int, **kw) -> ViT:
+    """ViT-Ti/16: 192 dim, 12 blocks, 3 heads (~5.7M params)."""
+    return ViT(num_classes=num_classes, patch=16, dim=192, depth=12,
+               num_heads=3, **kw)
+
+
+def vit_s16(num_classes: int, **kw) -> ViT:
+    """ViT-S/16: 384 dim, 12 blocks, 6 heads (~22M params)."""
+    return ViT(num_classes=num_classes, patch=16, dim=384, depth=12,
+               num_heads=6, **kw)

@@ -135,16 +135,28 @@ class ClassifierTask:
 
     def train_step(self, state: TrainState, batch: Batch):
         images, labels = self._images(batch), jnp.asarray(batch[self.label_key])
+        # Stat-free models (ViT: no BatchNorm anywhere) carry an empty
+        # batch_stats collection; passing it to apply (or asking for it
+        # back via mutable) would be a Flax error. Emptiness is static
+        # pytree structure, so this branch resolves at trace time.
+        has_stats = bool(state.batch_stats)
 
         def loss_fn(params):
-            logits, updates = self.model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                images,
-                train=True,
-                mutable=["batch_stats"],
-            )
+            if has_stats:
+                logits, updates = self.model.apply(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    images,
+                    train=True,
+                    mutable=["batch_stats"],
+                )
+                new_stats = updates["batch_stats"]
+            else:
+                logits = self.model.apply(
+                    {"params": params}, images, train=True
+                )
+                new_stats = state.batch_stats
             loss = cross_entropy_loss(logits, labels)
-            return loss, (logits, updates["batch_stats"])
+            return loss, (logits, new_stats)
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
@@ -167,11 +179,10 @@ class ClassifierTask:
 
     def eval_step(self, state: TrainState, batch: Batch):
         images, labels = self._images(batch), jnp.asarray(batch[self.label_key])
-        logits = self.model.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            images,
-            train=False,
-        )
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        logits = self.model.apply(variables, images, train=False)
         return {
             "val_loss": cross_entropy_loss(logits, labels),
             "val_acc": multiclass_accuracy(logits, labels),

@@ -1,0 +1,148 @@
+"""ViT classifier family: shapes, learnability, DP sharding, CLI.
+
+Parity context: the reference fine-tunes torchvision classifiers
+(``deep_learning/2...py:150``); ViT is the transformer half of that
+zoo, here trained through the identical ClassifierTask/Trainer stack as
+ResNet — including the stat-free (no BatchNorm) path those add to the
+task contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dss_ml_at_scale_tpu.models import ViT, vit_s16, vit_t16
+from dss_ml_at_scale_tpu.parallel import ClassifierTask, Trainer, TrainerConfig
+from dss_ml_at_scale_tpu.runtime import make_mesh
+
+from test_trainer import synthetic_batches
+
+
+def micro_vit(num_classes=4, patch=8, dim=32, depth=2, heads=2):
+    return ViT(num_classes=num_classes, patch=patch, dim=dim, depth=depth,
+               num_heads=heads, dtype=jnp.float32)
+
+
+def test_forward_shape_and_determinism():
+    model = micro_vit()
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 32, 32, 3)),
+                    jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    assert "batch_stats" not in variables  # stat-free by construction
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 4)
+    assert logits.dtype == jnp.float32
+    # train=True is the same function (no dropout/BN): bitwise equal.
+    assert jnp.array_equal(
+        logits, model.apply(variables, x, train=True)
+    )
+
+
+def test_indivisible_image_raises():
+    model = micro_vit(patch=8)
+    x = jnp.zeros((1, 36, 36, 3))
+    with pytest.raises(ValueError, match="not divisible"):
+        model.init(jax.random.key(0), x, train=False)
+
+
+def test_preset_geometries():
+    t = vit_t16(num_classes=10)
+    s = vit_s16(num_classes=10)
+    assert (t.dim, t.depth, t.num_heads) == (192, 12, 3)
+    assert (s.dim, s.depth, s.num_heads) == (384, 12, 6)
+    assert t.patch == s.patch == 16
+
+
+def test_vit_learns_under_trainer_dp(devices8):
+    """The quadrant task through the full DP trainer on the 8-dev mesh:
+    exercises the empty-batch_stats branch of train/eval steps."""
+    task = ClassifierTask(model=micro_vit(), tx=optax.adam(3e-3))
+    trainer = Trainer(
+        TrainerConfig(max_epochs=3, steps_per_epoch=30, log_every_steps=1000),
+        mesh=make_mesh(),
+    )
+    result = trainer.fit(
+        task,
+        iter(synthetic_batches(90)),
+        val_data_factory=lambda: synthetic_batches(3, seed=9),
+    )
+    assert result.history[-1]["train_loss"] < result.history[0]["train_loss"]
+    assert result.history[-1]["val_acc"] > 0.8  # chance = 0.25
+
+
+@pytest.mark.slow
+def test_vit_cli_train_predict_round_trip(tmp_path, capsys, devices8):
+    """dsst train --model vit-tiny -> predict: the checkpoint's
+    dsst_model.json carries the architecture, and the stat-free restore
+    / scoring path works end to end on a real JPEG Delta table."""
+    import json
+
+    import pyarrow as pa
+
+    from test_end_to_end import _jpeg
+
+    from dss_ml_at_scale_tpu.config.cli import main
+    from dss_ml_at_scale_tpu.config.commands import _read_delta_pandas
+    from dss_ml_at_scale_tpu.data import write_delta
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 64)
+    table = pa.table({
+        "content": pa.array([_jpeg(rng, l) for l in labels],
+                            type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    data = tmp_path / "images"
+    write_delta(table, data, max_rows_per_file=16)
+
+    ckpt = tmp_path / "ckpt"
+    assert main([
+        "train", "--data", str(data), "--model", "vit-tiny",
+        "--num-classes", "4", "--crop", "64", "--batch-size", "16",
+        "--epochs", "1", "--learning-rate", "0.003",
+        "--checkpoint-dir", str(ckpt),
+    ]) == 0
+    meta = json.loads((ckpt / "dsst_model.json").read_text())
+    assert meta["model"] == "vit-tiny"
+    capsys.readouterr()
+
+    out = tmp_path / "preds"
+    assert main([
+        "predict", "--data", str(data), "--checkpoint-dir", str(ckpt),
+        "--out", str(out), "--batch-size", "16",
+    ]) == 0
+    preds = _read_delta_pandas(out)
+    assert len(preds) == 64
+    assert set(preds["pred_index"].tolist()) <= {0, 1, 2, 3}
+
+
+def test_vit_predict_rejects_crop_mismatch(tmp_path):
+    """A ViT's pos table is sized by the training crop; predict with a
+    different --crop must fail up front with a clear message, not deep
+    in the orbax restore."""
+    import json
+
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "dsst_model.json").write_text(json.dumps(
+        {"model": "vit-tiny", "num_classes": 4, "crop": 64}
+    ))
+    with pytest.raises(SystemExit, match="trained with"):
+        main([
+            "predict", "--data", str(tmp_path), "--checkpoint-dir",
+            str(ckpt), "--out", str(tmp_path / "p"), "--crop", "128",
+        ])
+
+
+def test_pretrained_flag_rejected_for_vit(tmp_path):
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    with pytest.raises(SystemExit, match="no ViT converter"):
+        main([
+            "train", "--data", str(tmp_path), "--model", "vit-t16",
+            "--pretrained", str(tmp_path / "w.pth"),
+        ])

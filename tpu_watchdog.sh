@@ -5,9 +5,16 @@
 # smoke -> staged diag last (its bulk transfers are the likeliest to
 # stall, and a stall then costs nothing downstream).
 cd "$(dirname "$0")"
+N=0
 while true; do
-  echo "$(date -u +%H:%M:%S) probe" >> tpu_watchdog.log
-  timeout 150 python - >> tpu_watchdog.log 2>&1 <<'PY'
+  N=$((N + 1))
+  # Quick probes catch a healthy tunnel; every 4th probe is patient
+  # (20 min) because the observed half-up regime resolves a claim
+  # definitively in ~25 min, and killing a claim mid-flight leaves a
+  # stale lease that poisons the next one.
+  PT=150; [ $((N % 4)) -eq 0 ] && PT=1200
+  echo "$(date -u +%H:%M:%S) probe #$N (timeout ${PT}s)" >> tpu_watchdog.log
+  timeout $PT python - >> tpu_watchdog.log 2>&1 <<'PY'
 import jax
 d = jax.devices()[0]
 assert d.platform != "cpu"
