@@ -447,12 +447,6 @@ def register_train(sub: argparse._SubParsersAction) -> None:
 def _cmd_train(args: argparse.Namespace) -> int:
     import optax
 
-    if args.pretrained and args.model.startswith("vit"):
-        raise SystemExit(
-            "--pretrained converts torchvision ResNet layouts; there is "
-            "no ViT converter yet (train --model vit-* from scratch)"
-        )
-
     from ..data import DeltaTable, batch_loader
     from ..data.transform import imagenet_transform_spec
     from ..parallel import ClassifierTask, Trainer, TrainerConfig
@@ -531,11 +525,12 @@ def _cmd_train(args: argparse.Namespace) -> int:
     if args.pretrained and not _has_checkpoint(args):
         # With --resume and an existing checkpoint the restore would
         # overwrite these weights anyway — skip the conversion.
-        from ..models.pretrained import load_pretrained_resnet
+        if args.model.startswith("vit"):
+            from ..models.pretrained import load_pretrained_vit as _load
+        else:
+            from ..models.pretrained import load_pretrained_resnet as _load
 
-        variables = load_pretrained_resnet(
-            args.pretrained, model, image_size=args.crop
-        )
+        variables = _load(args.pretrained, model, image_size=args.crop)
         init_state = task.state_from_variables(variables)
 
     tracker = _open_tracker(args, "train")

@@ -85,25 +85,29 @@ def load_state_dict(path: str | Path) -> dict[str, np.ndarray]:
 
 # Unlike conv1/bn1 (which recur inside blocks as layerN.M.conv1...), the
 # classifier head exists exactly once at the torchvision layout's root.
-_ANCHOR = "fc.weight"
+# ResNets anchor at fc.weight, ViTs at heads.head.weight.
+_ANCHORS = ("fc.weight", "heads.head.weight")
 
 
 def _strip_wrapper_prefix(state: dict) -> dict:
     """Strip a uniform wrapper prefix (``model.``/``module.``/anything)."""
-    if _ANCHOR in state:
-        return state
-    prefixes = {k[: -len(_ANCHOR)] for k in state if k.endswith(_ANCHOR)}
-    if len(prefixes) != 1:
-        return state  # no (or ambiguous) anchor: leave keys untouched
-    prefix = prefixes.pop()
-    if not prefix or not prefix.endswith("."):
-        # Either no wrapper, or the anchor match is a partial key like
-        # ``aux_fc.weight`` — stripping would mangle sibling keys.
-        return state
-    return {
-        (k[len(prefix):] if k.startswith(prefix) else k): v
-        for k, v in state.items()
-    }
+    for anchor in _ANCHORS:
+        if anchor in state:
+            return state
+    for anchor in _ANCHORS:
+        prefixes = {k[: -len(anchor)] for k in state if k.endswith(anchor)}
+        if len(prefixes) != 1:
+            continue  # no (or ambiguous) anchor: try the next family
+        prefix = prefixes.pop()
+        if not prefix or not prefix.endswith("."):
+            # Either no wrapper, or the anchor match is a partial key
+            # like ``aux_fc.weight`` — stripping would mangle siblings.
+            continue
+        return {
+            (k[len(prefix):] if k.startswith(prefix) else k): v
+            for k, v in state.items()
+        }
+    return state
 
 
 def _torch_name(path: tuple[str, ...], stage_sizes) -> tuple[str, str]:
@@ -150,6 +154,48 @@ _TRANSFORMS = {
 }
 
 
+def _fill_template(
+    state: Mapping[str, Any],
+    variables: Mapping[str, Any],
+    resolve,
+    *,
+    reinit_module: str | None,
+):
+    """Template-guided conversion shared by both families.
+
+    Walks every leaf of ``variables`` (from ``model.init``); ``resolve``
+    maps a flax key path to ``(torch key candidates, transform)``. A
+    leaf under the top-level module ``reinit_module`` keeps its fresh
+    initialization (the new-class-count fine-tune case).
+    """
+    import jax
+
+    state = {k: _to_numpy(v) for k, v in state.items()}
+
+    def fill(path, leaf):
+        keys = tuple(
+            getattr(p, "key", getattr(p, "name", str(p))) for p in path
+        )
+        if reinit_module is not None and keys[1] == reinit_module:
+            return leaf
+        candidates, transform = resolve(keys)
+        key = next((k for k in candidates if k in state), None)
+        if key is None:
+            raise KeyError(
+                f"pretrained state has none of {candidates!r} "
+                f"(for flax {keys})"
+            )
+        arr = transform(state[key])
+        if arr.shape != leaf.shape:
+            raise ValueError(
+                f"{key}: shape {arr.shape} != model {leaf.shape} "
+                f"(flax {keys})"
+            )
+        return np.asarray(arr, dtype=np.asarray(leaf).dtype)
+
+    return jax.tree_util.tree_map_with_path(fill, dict(variables))
+
+
 def convert_torchvision_resnet(
     state: Mapping[str, Any],
     variables: Mapping[str, Any],
@@ -168,28 +214,131 @@ def convert_torchvision_resnet(
     labels case where the model's class count differs from the
     checkpoint's.
     """
-    import jax
 
-    state = {k: _to_numpy(v) for k, v in state.items()}
-
-    def fill(path, leaf):
-        keys = tuple(getattr(p, "key", getattr(p, "name", str(p))) for p in path)
-        if reinit_head and keys[1] == "Dense_0":
-            return leaf
+    def resolve(keys):
         torch_key, tag = _torch_name(keys, stage_sizes)
-        if torch_key not in state:
-            raise KeyError(
-                f"pretrained state has no {torch_key!r} (for flax {keys})"
-            )
-        arr = _TRANSFORMS[tag](state[torch_key])
-        if arr.shape != leaf.shape:
-            raise ValueError(
-                f"{torch_key}: shape {arr.shape} != model {leaf.shape} "
-                f"(flax {keys})"
-            )
-        return np.asarray(arr, dtype=np.asarray(leaf).dtype)
+        return [torch_key], _TRANSFORMS[tag]
 
-    return jax.tree_util.tree_map_with_path(fill, dict(variables))
+    return _fill_template(
+        state, variables, resolve,
+        reinit_module="Dense_0" if reinit_head else None,
+    )
+
+
+def _vit_torch_name(keys: tuple[str, ...]):
+    """Flax ViT param path → (torch key candidates, transform tag).
+
+    Torchvision ``VisionTransformer`` layout: ``conv_proj``,
+    ``class_token``, ``encoder.pos_embedding``,
+    ``encoder.layers.encoder_layer_i.{ln_1, self_attention, ln_2, mlp}``,
+    ``encoder.ln``, ``heads.head``.  The fused attention projection
+    (``in_proj_weight`` [3d, d]) is split into this repo's separate
+    q/k/v Dense rows; the MLP's two Linears appear as Sequential indices
+    (``mlp.0`` / ``mlp.3``) on current torchvision and as
+    ``mlp.linear_1`` / ``mlp.linear_2`` on older releases — both are
+    accepted.
+    """
+    mod, *rest = keys[1:]  # keys[0] is the collection ("params")
+    leaf = keys[-1]
+    wb = "weight" if leaf in ("kernel", "scale") else "bias"
+    if mod == "patch_embed":
+        return ([f"conv_proj.{wb}"], "conv" if leaf == "kernel" else "none")
+    if mod == "cls_token":
+        return (["class_token"], "none")
+    if mod == "pos_embed":
+        return (["encoder.pos_embedding"], "none")
+    if mod == "ln_final":
+        return ([f"encoder.ln.{wb}"], "none")
+    if mod == "head":
+        return ([f"heads.head.{wb}"],
+                "dense" if leaf == "kernel" else "none")
+    if mod.startswith("block_"):
+        i = int(mod[6:])
+        prefix = f"encoder.layers.encoder_layer_{i}"
+        inner = rest[0]
+        if inner == "ln_attn":
+            return ([f"{prefix}.ln_1.{wb}"], "none")
+        if inner == "ln_mlp":
+            return ([f"{prefix}.ln_2.{wb}"], "none")
+        if inner in ("q", "k", "v"):
+            part = "in_proj_weight" if leaf == "kernel" else "in_proj_bias"
+            tag = f"qkv_{inner}_{'dense' if leaf == 'kernel' else 'bias'}"
+            return ([f"{prefix}.self_attention.{part}"], tag)
+        if inner == "attn_out":
+            return ([f"{prefix}.self_attention.out_proj.{wb}"],
+                    "dense" if leaf == "kernel" else "none")
+        if inner == "mlp_in":
+            return ([f"{prefix}.mlp.0.{wb}", f"{prefix}.mlp.linear_1.{wb}"],
+                    "dense" if leaf == "kernel" else "none")
+        if inner == "mlp_out":
+            return ([f"{prefix}.mlp.3.{wb}", f"{prefix}.mlp.linear_2.{wb}"],
+                    "dense" if leaf == "kernel" else "none")
+    raise KeyError(f"no torchvision ViT mapping for flax path {keys}")
+
+
+def _qkv_split(which: str):
+    idx = {"q": 0, "k": 1, "v": 2}[which]
+
+    def split(a: np.ndarray) -> np.ndarray:
+        d = a.shape[0] // 3
+        return a[idx * d:(idx + 1) * d]
+
+    return split
+
+
+def _vit_transform(tag: str, arr: np.ndarray) -> np.ndarray:
+    if tag.startswith("qkv_"):
+        _, which, kind = tag.split("_")
+        arr = _qkv_split(which)(arr)
+        return arr.T if kind == "dense" else arr
+    return _TRANSFORMS[tag](arr)
+
+
+def convert_torchvision_vit(
+    state: Mapping[str, Any],
+    variables: Mapping[str, Any],
+    *,
+    reinit_head: bool = False,
+) -> dict:
+    """Fill a ViT ``variables`` template from a torchvision state dict.
+
+    Template-guided like :func:`convert_torchvision_resnet`: every leaf
+    must find a torch tensor of the right post-transform shape; extra
+    torch keys are ignored. ``reinit_head=True`` keeps the fresh head.
+    """
+
+    def resolve(keys):
+        candidates, tag = _vit_torch_name(keys)
+        return candidates, lambda a: _vit_transform(tag, a)
+
+    return _fill_template(
+        state, variables, resolve,
+        reinit_module="head" if reinit_head else None,
+    )
+
+
+def load_pretrained_vit(path: str | Path, model, image_size: int = 224):
+    """Path → converted ``{"params"}`` for a :class:`ViT`.
+
+    The position table is sized by ``image_size``; a checkpoint trained
+    at a different resolution fails the shape check loudly (position
+    interpolation is not implemented). A missing or class-count-
+    mismatched ``heads.head`` keeps the fresh initialization.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    template = model.init(
+        jax.random.key(0),
+        jnp.zeros((1, image_size, image_size, 3)),
+        train=False,
+    )
+    state = load_state_dict(path)
+    reinit_head = (
+        "heads.head.weight" not in state
+        or state["heads.head.weight"].shape[0] != model.num_classes
+    )
+    return convert_torchvision_vit(state, template, reinit_head=reinit_head)
 
 
 def load_pretrained_resnet(path: str | Path, model, image_size: int = 224):

@@ -76,7 +76,9 @@ class ViTBlock(nn.Module):
 
         h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
         h = dense(self.dim * self.mlp_ratio, "mlp_in")(h)
-        h = nn.gelu(h)
+        # Exact (erf) GELU: torch nn.GELU's default, so converted
+        # torchvision-layout weights reproduce torch numerics.
+        h = nn.gelu(h, approximate=False)
         return x + dense(self.dim, "mlp_out")(h)
 
 

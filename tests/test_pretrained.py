@@ -497,3 +497,130 @@ def test_resnet50_matches_live_torch_forward_on_real_photo(tmp_path):
         fused.apply(variables, jnp.asarray(x_nhwc), train=False)
     )
     np.testing.assert_allclose(logits_fused, ref, rtol=1e-4, atol=5e-4)
+
+
+# --------------------------------------------------------------------------
+# ViT: torchvision VisionTransformer layout -> models/vit.py
+# --------------------------------------------------------------------------
+
+def _torch_mini_vit(torch, *, num_classes=6, patch=8, dim=32, depth=2,
+                    heads=2, mlp_ratio=4, image=32, seed=0):
+    """A live torch module whose state-dict keys and forward semantics
+    reproduce torchvision's VisionTransformer (conv_proj / class_token /
+    encoder.pos_embedding / encoder.layers.encoder_layer_i.{ln_1,
+    self_attention, ln_2, mlp(Sequential 0..4)} / encoder.ln /
+    heads.head) — defined here independently of the converter so the
+    parity test pins numerics against torch's own arithmetic."""
+    nn = torch.nn
+    torch.manual_seed(seed)
+    n = (image // patch) ** 2
+
+    class MiniViT(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv_proj = nn.Conv2d(3, dim, patch, stride=patch)
+            self.class_token = nn.Parameter(torch.randn(1, 1, dim) * 0.02)
+            encoder = nn.Module()
+            encoder.pos_embedding = nn.Parameter(
+                torch.randn(1, n + 1, dim) * 0.02
+            )
+            layers = nn.Module()
+            for i in range(depth):
+                blk = nn.Module()
+                blk.ln_1 = nn.LayerNorm(dim, eps=1e-6)
+                blk.self_attention = nn.MultiheadAttention(
+                    dim, heads, batch_first=True
+                )
+                blk.ln_2 = nn.LayerNorm(dim, eps=1e-6)
+                blk.mlp = nn.Sequential(
+                    nn.Linear(dim, dim * mlp_ratio), nn.GELU(),
+                    nn.Dropout(0.0), nn.Linear(dim * mlp_ratio, dim),
+                    nn.Dropout(0.0),
+                )
+                setattr(layers, f"encoder_layer_{i}", blk)
+            encoder.layers = layers
+            encoder.ln = nn.LayerNorm(dim, eps=1e-6)
+            self.encoder = encoder
+            heads_mod = nn.Module()
+            heads_mod.head = nn.Linear(dim, num_classes)
+            self.heads = heads_mod
+            self._depth = depth
+
+        def forward(self, x):  # [b, 3, h, w]
+            b = x.shape[0]
+            x = self.conv_proj(x).flatten(2).transpose(1, 2)  # [b, n, dim]
+            x = torch.cat([self.class_token.expand(b, -1, -1), x], dim=1)
+            x = x + self.encoder.pos_embedding
+            for i in range(self._depth):
+                blk = getattr(self.encoder.layers, f"encoder_layer_{i}")
+                h = blk.ln_1(x)
+                a, _ = blk.self_attention(h, h, h, need_weights=False)
+                x = x + a
+                x = x + blk.mlp(blk.ln_2(x))
+            return self.heads.head(self.encoder.ln(x)[:, 0])
+
+    return MiniViT()
+
+
+def test_vit_matches_live_torch_forward(tmp_path):
+    torch = pytest.importorskip("torch")
+
+    from dss_ml_at_scale_tpu.models.pretrained import load_pretrained_vit
+    from dss_ml_at_scale_tpu.models.vit import ViT
+
+    tmodel = _torch_mini_vit(torch)
+    path = tmp_path / "vit.pt"
+    torch.save(tmodel.state_dict(), path)
+
+    rng = np.random.default_rng(0)
+    x_nhwc = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        tmodel.eval()
+        ref = tmodel(
+            torch.from_numpy(np.transpose(x_nhwc, (0, 3, 1, 2)))
+        ).numpy()
+
+    model = ViT(num_classes=6, patch=8, dim=32, depth=2, num_heads=2,
+                dtype=jnp.float32)
+    variables = load_pretrained_vit(path, model, image_size=32)
+    logits = np.asarray(
+        model.apply(variables, jnp.asarray(x_nhwc), train=False)
+    )
+    np.testing.assert_allclose(logits, ref, rtol=1e-4, atol=5e-4)
+
+
+def test_vit_reinit_head_on_class_mismatch(tmp_path):
+    torch = pytest.importorskip("torch")
+
+    from dss_ml_at_scale_tpu.models.pretrained import load_pretrained_vit
+    from dss_ml_at_scale_tpu.models.vit import ViT
+
+    tmodel = _torch_mini_vit(torch, num_classes=6)
+    path = tmp_path / "vit.pt"
+    torch.save(tmodel.state_dict(), path)
+
+    model = ViT(num_classes=11, patch=8, dim=32, depth=2, num_heads=2,
+                dtype=jnp.float32)
+    variables = load_pretrained_vit(path, model, image_size=32)
+    # Backbone converted, head kept at its fresh (template) init.
+    assert variables["params"]["head"]["kernel"].shape == (32, 11)
+    np.testing.assert_array_equal(
+        np.asarray(variables["params"]["cls_token"]).squeeze(),
+        tmodel.class_token.detach().numpy().squeeze(),
+    )
+
+
+def test_vit_resolution_mismatch_fails_loudly(tmp_path):
+    torch = pytest.importorskip("torch")
+
+    from dss_ml_at_scale_tpu.models.pretrained import load_pretrained_vit
+    from dss_ml_at_scale_tpu.models.vit import ViT
+
+    tmodel = _torch_mini_vit(torch, image=32)
+    path = tmp_path / "vit.pt"
+    torch.save(tmodel.state_dict(), path)
+
+    model = ViT(num_classes=6, patch=8, dim=32, depth=2, num_heads=2,
+                dtype=jnp.float32)
+    with pytest.raises(ValueError, match="pos_embedding"):
+        load_pretrained_vit(path, model, image_size=64)

@@ -138,11 +138,43 @@ def test_vit_predict_rejects_crop_mismatch(tmp_path):
         ])
 
 
-def test_pretrained_flag_rejected_for_vit(tmp_path):
-    from dss_ml_at_scale_tpu.config.cli import main
+@pytest.mark.slow
+def test_vit_cli_pretrained_fine_tune_start(tmp_path, capsys, devices8):
+    """dsst train --model vit-tiny --pretrained <torchvision-layout .pt>
+    converts the backbone (head re-initialized for the new class count)
+    and trains — the reference's fine-tune-from-torchvision flow
+    (2...py:150) on the second model family."""
+    torch = pytest.importorskip("torch")
 
-    with pytest.raises(SystemExit, match="no ViT converter"):
-        main([
-            "train", "--data", str(tmp_path), "--model", "vit-t16",
-            "--pretrained", str(tmp_path / "w.pth"),
-        ])
+    import pyarrow as pa
+
+    from test_end_to_end import _jpeg
+    from test_pretrained import _torch_mini_vit
+
+    from dss_ml_at_scale_tpu.config.cli import main
+    from dss_ml_at_scale_tpu.data import write_delta
+
+    tmodel = _torch_mini_vit(torch, num_classes=6, image=32)
+    weights = tmp_path / "vit.pt"
+    torch.save(tmodel.state_dict(), weights)
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 32)
+    table = pa.table({
+        "content": pa.array([_jpeg(rng, l) for l in labels],
+                            type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    data = tmp_path / "images"
+    write_delta(table, data, max_rows_per_file=16)
+
+    assert main([
+        "train", "--data", str(data), "--model", "vit-tiny",
+        "--num-classes", "4", "--crop", "32", "--batch-size", "16",
+        "--epochs", "1", "--pretrained", str(weights),
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ]) == 0
+    import json
+
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["steps"] == 2  # 32 rows // 16
