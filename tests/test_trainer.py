@@ -179,11 +179,22 @@ def test_lm_task_trains_under_trainer(devices8):
     assert result.history[1]["train_ppl"] < 5.0
 
 
-def test_zero1_opt_state_sharding_matches_replicated(devices8, task):
+@pytest.mark.parametrize("family", ["resnet", "vit"])
+def test_zero1_opt_state_sharding_matches_replicated(devices8, family):
     """ZeRO-1 (shard_opt_state=True) must change only layout and memory:
     identical training math, optimizer moments physically split over the
-    mesh axis along their largest divisible dim."""
+    mesh axis along their largest divisible dim. Parameterized over both
+    classifier families (BN-stateful ResNet, stat-free ViT)."""
     import jax
+    import optax
+
+    def task_fn():
+        if family == "vit":
+            from test_vit import micro_vit
+
+            return ClassifierTask(model=micro_vit(), tx=optax.adam(1e-3))
+        return ClassifierTask(model=tiny_resnet(num_classes=4),
+                              tx=optax.adam(1e-2))
 
     batches = synthetic_batches(8)
     mesh = make_mesh()
@@ -196,7 +207,7 @@ def test_zero1_opt_state_sharding_matches_replicated(devices8, task):
             ),
             mesh=mesh,
         )
-        return trainer.fit(task, iter([dict(b) for b in batches]))
+        return trainer.fit(task_fn(), iter([dict(b) for b in batches]))
 
     repl = run(False)
     zero1 = run(True)
