@@ -401,6 +401,14 @@ def register_train(sub: argparse._SubParsersAction) -> None:
         "fewer HBM bytes per step — the v5e throughput lever. "
         "--no-fused-bn falls back to flax BatchNorm",
     )
+    tr.add_argument(
+        "--augment", action="store_true",
+        help="on-device train-time RandomResizedCrop + horizontal flip "
+        "inside the jitted step (data/augment.py): the reference's "
+        "torchvision train transform, run on the chip instead of host "
+        "decode workers; keyed by the training step, so resume replays "
+        "the identical crop schedule. Eval/predict never augment",
+    )
     tr.add_argument("--workers", type=int, default=2)
     tr.add_argument("--queue-size", type=int, default=20)
     tr.add_argument(
@@ -519,7 +527,12 @@ def _cmd_train(args: argparse.Namespace) -> int:
         args.model, num_classes=args.num_classes, torch_padding=torch_padding,
         fused_bn=args.fused_bn,
     )
-    task = ClassifierTask(model=model, tx=optax.adam(lr))
+    augment = None
+    if args.augment:
+        from ..data.augment import AugmentConfig
+
+        augment = AugmentConfig()
+    task = ClassifierTask(model=model, tx=optax.adam(lr), augment=augment)
 
     init_state = None
     if args.pretrained and not _has_checkpoint(args):

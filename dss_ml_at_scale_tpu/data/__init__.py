@@ -12,6 +12,8 @@ from .delta import DeltaTable, write_delta  # noqa: F401
 from .reader import ParquetShardReader, batch_loader, make_batch_reader  # noqa: F401
 from .sharding import RowGroupUnit, list_row_groups, shard_units  # noqa: F401
 from .transform import TransformSpec  # noqa: F401
+# augment imports jax (device-side transform); import it lazily as
+# dss_ml_at_scale_tpu.data.augment to keep jax-free paths jax-free.
 
 
 def __getattr__(name):

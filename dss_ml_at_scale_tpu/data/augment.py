@@ -1,0 +1,130 @@
+"""On-device training augmentation: RandomResizedCrop + flip, jitted.
+
+The reference's train-time transform is torchvision's
+``RandomResizedCrop(224)`` + ``RandomHorizontalFlip`` running on host
+CPU workers (``deep_learning/2.distributed-data-loading-petastorm.py``
+transform pipeline). On TPU hosts the feeding formula
+(``compute_ips / decode_ips_per_core``, see README) makes host cores
+the scarce resource — so this framework runs augmentation ON DEVICE,
+inside the jitted train step:
+
+- the decode pool keeps emitting deterministic center-crops (cheap,
+  cacheable, identical for eval);
+- the train step derives a per-step PRNG key by folding ``state.step``
+  into a base seed (deterministic across restarts and mesh layouts —
+  resume replays the same crop sequence), samples one crop box + flip
+  bit per image, and materializes the crop with
+  ``jax.image.scale_and_translate`` — a fixed-output-shape bilinear
+  gather XLA maps onto the chip, vmapped over the batch;
+- eval and predict never augment.
+
+Box sampling is the single-draw variant of torchvision's algorithm:
+one (area, log-ratio) draw clamped to fit, instead of the 10-try
+rejection loop — rejection loops are data-dependent control flow, which
+is exactly what a compiled TPU program should not contain. The sampled
+distribution differs only in the rare tail where torchvision's tries
+all fail and it falls back to a center crop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class AugmentConfig:
+    """RandomResizedCrop + horizontal-flip parameters (torchvision
+    semantics: ``scale`` is the area fraction range, ``ratio`` the
+    aspect-ratio range of the sampled box)."""
+
+    scale: tuple[float, float] = (0.08, 1.0)
+    ratio: tuple[float, float] = (3.0 / 4.0, 4.0 / 3.0)
+    flip: bool = True
+    seed: int = 0
+
+
+def _sample_boxes(key, batch, h, w, cfg: AugmentConfig):
+    """Per-image crop boxes: (top, left, box_h, box_w), float32."""
+    k_area, k_ratio, k_top, k_left = jax.random.split(key, 4)
+    area = h * w * jax.random.uniform(
+        k_area, (batch,), minval=cfg.scale[0], maxval=cfg.scale[1]
+    )
+    log_r = jax.random.uniform(
+        k_ratio, (batch,),
+        minval=jnp.log(cfg.ratio[0]), maxval=jnp.log(cfg.ratio[1]),
+    )
+    r = jnp.exp(log_r)
+    box_w = jnp.sqrt(area * r)
+    box_h = jnp.sqrt(area / r)
+    # Clamp to the source extent (the one-draw stand-in for the
+    # rejection loop), keeping at least an 8x8 box for stability.
+    box_w = jnp.clip(box_w, 8.0, w)
+    box_h = jnp.clip(box_h, 8.0, h)
+    top = jax.random.uniform(k_top, (batch,)) * (h - box_h)
+    left = jax.random.uniform(k_left, (batch,)) * (w - box_w)
+    return top, left, box_h, box_w
+
+
+def random_resized_crop_flip(
+    key: jax.Array,
+    images: jax.Array,  # [b, h, w, c] float
+    crop: int,
+    cfg: AugmentConfig = AugmentConfig(),
+) -> jax.Array:
+    """Augmented ``[b, crop, crop, c]`` batch, fully on device."""
+    b, h, w, c = images.shape
+    k_box, k_flip = jax.random.split(key)
+    top, left, box_h, box_w = _sample_boxes(k_box, b, float(h), float(w), cfg)
+
+    if cfg.flip:
+        do_flip = jax.random.bernoulli(k_flip, 0.5, (b,))
+        images = jnp.where(
+            do_flip[:, None, None, None], images[:, :, ::-1, :], images
+        )
+
+    # Map the sampled box onto the fixed output window:
+    # out[y, x] = in[top + y * box_h/crop, left + x * box_w/crop].
+    scale_y = crop / box_h
+    scale_x = crop / box_w
+
+    def one(img, sy, sx, t, l):
+        return jax.image.scale_and_translate(
+            img,
+            shape=(crop, crop, c),
+            spatial_dims=(0, 1),
+            scale=jnp.stack([sy, sx]),
+            translation=jnp.stack([-t * sy, -l * sx]),
+            method="bilinear",
+        )
+
+    out = jax.vmap(one)(images, scale_y, scale_x, top, left)
+    return out.astype(images.dtype)
+
+
+def augment_for_step(
+    step: jax.Array,
+    images: jax.Array,
+    crop: int,
+    cfg: AugmentConfig = AugmentConfig(),
+) -> jax.Array:
+    """The train-step entry point: a deterministic per-step key.
+
+    ``fold_in(key(seed), step)`` makes the crop sequence a pure function
+    of (seed, step): checkpoint resume replays the exact schedule, and
+    every process in a multi-host DP run derives the same key (each
+    already holds different rows, so crops stay decorrelated across the
+    global batch).
+    """
+    key = jax.random.fold_in(jax.random.key(cfg.seed), step)
+    return random_resized_crop_flip(key, images, crop, cfg)
+
+
+__all__ = [
+    "AugmentConfig",
+    "augment_for_step",
+    "random_resized_crop_flip",
+]

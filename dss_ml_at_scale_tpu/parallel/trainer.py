@@ -83,6 +83,10 @@ class ClassifierTask:
     # normalize differently.
     norm_mean: Any = None
     norm_std: Any = None
+    # On-device train-time augmentation (RandomResizedCrop + flip inside
+    # the jitted step, keyed by state.step — see data/augment.py). None
+    # disables; eval/predict are never augmented.
+    augment: Any = None
 
     @property
     def _norm_constants(self):
@@ -135,6 +139,12 @@ class ClassifierTask:
 
     def train_step(self, state: TrainState, batch: Batch):
         images, labels = self._images(batch), jnp.asarray(batch[self.label_key])
+        if self.augment is not None:
+            from ..data.augment import augment_for_step
+
+            images = augment_for_step(
+                state.step, images, images.shape[1], self.augment
+            )
         # Stat-free models (ViT: no BatchNorm anywhere) carry an empty
         # batch_stats collection; passing it to apply (or asking for it
         # back via mutable) would be a Flax error. Emptiness is static

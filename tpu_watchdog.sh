@@ -9,10 +9,10 @@ N=0
 while true; do
   N=$((N + 1))
   # Quick probes catch a healthy tunnel; every 4th probe is patient
-  # (20 min) because the observed half-up regime resolves a claim
-  # definitively in ~25 min, and killing a claim mid-flight leaves a
-  # stale lease that poisons the next one.
-  PT=150; [ $((N % 4)) -eq 0 ] && PT=1200
+  # (30 min): the one observed definitive resolution of a half-up claim
+  # took ~25 min (a 20-min probe hung to its kill), and killing a claim
+  # mid-flight leaves a stale lease that poisons the next one.
+  PT=150; [ $((N % 4)) -eq 0 ] && PT=1800
   echo "$(date -u +%H:%M:%S) probe #$N (timeout ${PT}s)" >> tpu_watchdog.log
   timeout $PT python - >> tpu_watchdog.log 2>&1 <<'PY'
 import jax
