@@ -689,24 +689,22 @@ def register_predict(sub: argparse._SubParsersAction) -> None:
     pr.set_defaults(fn=_cmd_predict)
 
 
-def _cmd_predict(args: argparse.Namespace) -> int:
-    import numpy as np
-    import pyarrow as pa
+def _checkpoint_task(checkpoint_dir, crop_override=None):
+    """(meta, crop, model, task) for a dsst-train checkpoint — the one
+    meta-reading path shared by predict and export, so restore-critical
+    branches (schedule-shaped optimizer, fused-BN fidelity, the ViT
+    crop pin) cannot drift between the two commands.
 
-    import jax
-    import jax.numpy as jnp
-
-    from ..data import DeltaTable, batch_loader, write_delta
-    from ..data.transform import imagenet_transform_spec
-    from ..parallel import ClassifierTask, restore_state
-
-    meta_path = Path(args.checkpoint_dir) / "dsst_model.json"
+    Prints the missing-meta diagnosis and returns None if the directory
+    carries no ``dsst_model.json`` (callers just ``return 1``).
+    """
+    meta_path = Path(checkpoint_dir) / "dsst_model.json"
     if not meta_path.exists():
-        print(f"no dsst_model.json under {args.checkpoint_dir}; "
+        print(f"no dsst_model.json under {checkpoint_dir}; "
               "was this checkpoint written by dsst train?")
-        return 1
+        return None
     meta = json.loads(meta_path.read_text())
-    crop = args.crop or int(meta.get("crop", 224))
+    crop = crop_override or int(meta.get("crop", 224))
     if (
         str(meta.get("model", "")).startswith("vit")
         and meta.get("crop")
@@ -721,6 +719,8 @@ def _cmd_predict(args: argparse.Namespace) -> int:
             f"{meta['crop']}: ViT checkpoints must be scored at the "
             "crop they were trained with"
         )
+    from ..parallel import ClassifierTask
+
     model = _build_classifier_model(
         meta.get("model", "resnet50"),
         num_classes=int(meta["num_classes"]),
@@ -741,6 +741,24 @@ def _cmd_predict(args: argparse.Namespace) -> int:
         )
     else:
         task = ClassifierTask(model=model)
+    return meta, crop, model, task
+
+
+def _cmd_predict(args: argparse.Namespace) -> int:
+    import numpy as np
+    import pyarrow as pa
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..data import DeltaTable, batch_loader, write_delta
+    from ..data.transform import imagenet_transform_spec
+    from ..parallel import restore_state
+
+    resolved = _checkpoint_task(args.checkpoint_dir, args.crop)
+    if resolved is None:
+        return 1
+    meta, crop, model, task = resolved
 
     table = DeltaTable(args.data)
     spec = imagenet_transform_spec(crop=crop, backend=args.decode_backend)
@@ -1294,6 +1312,57 @@ def _read_delta_pandas(path: str, columns: list[str] | None = None):
     return pa.concat_tables(parts).to_pandas()
 
 
+def register_export(sub: argparse._SubParsersAction) -> None:
+    ex = sub.add_parser(
+        "export",
+        help="trained checkpoint → torchvision-layout .npz state dict "
+        "(readable by torch-ecosystem consumers and by this CLI's own "
+        "--pretrained; BN num_batches_tracked is not emitted — use "
+        "load_state_dict(strict=False) on the torch side)",
+    )
+    ex.add_argument("--checkpoint-dir", required=True,
+                    help="a dsst train checkpoint dir (dsst_model.json)")
+    ex.add_argument("--out", required=True, help=".npz output path")
+    ex.add_argument("--step", type=int, default=None,
+                    help="explicit checkpoint step (default: best, else latest)")
+    ex.set_defaults(fn=_cmd_export)
+
+
+def _cmd_export(args: argparse.Namespace) -> int:
+    import numpy as np
+
+    from ..models.pretrained import export_torchvision
+    from ..parallel import restore_state
+
+    if not args.out.endswith(".npz"):
+        # export_torchvision also enforces this; failing before the
+        # (slow) restore gives the error immediately.
+        raise SystemExit(f"--out must end in .npz (got {args.out!r})")
+    resolved = _checkpoint_task(args.checkpoint_dir)
+    if resolved is None:
+        return 1
+    _meta, crop, model, task = resolved
+    sample = {
+        "image": np.zeros((1, crop, crop, 3), np.float32),
+        "label": np.zeros((1,), np.int32),
+    }
+    state, step = restore_state(task, sample, args.checkpoint_dir,
+                                step=args.step)
+    # Export never touches the optimizer; free its ~2x-params memory
+    # before materializing the numpy copies (restore_state's guidance).
+    variables = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    state = None
+    exported = export_torchvision(variables, model, args.out)
+    print(json.dumps({
+        "checkpoint_step": step,
+        "tensors": len(exported),
+        "out": args.out,
+    }))
+    return 0
+
+
 def register_all(sub: argparse._SubParsersAction) -> None:
     register_datagen(sub)
     register_forecast(sub)
@@ -1301,6 +1370,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_ingest(sub)
     register_train(sub)
     register_predict(sub)
+    register_export(sub)
     register_lm(sub)
     register_hpo(sub)
     register_trial_worker(sub)

@@ -341,6 +341,64 @@ def load_pretrained_vit(path: str | Path, model, image_size: int = 224):
     return convert_torchvision_vit(state, template, reinit_head=reinit_head)
 
 
+def export_torchvision(variables: Mapping[str, Any], model,
+                       path: str | Path) -> dict[str, np.ndarray]:
+    """Inverse converter: Flax variables → torchvision-layout ``.npz``.
+
+    The migration loop runs both ways: a model trained here can be
+    handed back to a torch-ecosystem consumer (or to this framework's
+    own ``--pretrained``, which reads ``.npz`` in the same layout).
+    Transforms are the exact inverses of the load path — HWIO→OIHW,
+    [in,out]→[out,in], and for ViT the q/k/v kernels re-fused into
+    ``in_proj_weight``/``in_proj_bias``.
+
+    Returns the exported dict (also written to ``path``).
+    """
+    import jax
+
+    is_vit = "cls_token" in variables.get("params", {})
+    out: dict[str, np.ndarray] = {}
+    partial_qkv: dict[str, dict[str, np.ndarray]] = {}
+
+    def put(path_keys, leaf):
+        keys = tuple(
+            getattr(p, "key", getattr(p, "name", str(p))) for p in path_keys
+        )
+        arr = np.asarray(leaf)
+        if is_vit:
+            candidates, tag = _vit_torch_name(keys)
+            key = candidates[0]
+            if tag.startswith("qkv_"):
+                # Collect q/k/v parts; fuse once all three are present.
+                _, which, kind = tag.split("_")
+                slot = partial_qkv.setdefault(key, {})
+                slot[which] = arr.T if kind == "dense" else arr
+                if len(slot) == 3:
+                    out[key] = np.concatenate(
+                        [slot["q"], slot["k"], slot["v"]], axis=0
+                    )
+                return
+        else:
+            key, tag = _torch_name(keys, model.stage_sizes)
+        if tag == "conv":
+            arr = np.transpose(arr, (3, 2, 0, 1))  # HWIO -> OIHW
+        elif tag == "dense":
+            arr = np.transpose(arr, (1, 0))  # [in,out] -> [out,in]
+        out[key] = arr
+
+    path = Path(path)
+    if path.suffix != ".npz":
+        # np.savez silently appends ".npz", writing a different path
+        # than the caller asked for; refuse instead of lying.
+        raise ValueError(f"export path must end in .npz (got {path})")
+    jax.tree_util.tree_map_with_path(put, dict(variables))
+    missing = [k for k, v in partial_qkv.items() if len(v) != 3]
+    if missing:
+        raise ValueError(f"incomplete q/k/v triples for {missing}")
+    np.savez(path, **out)
+    return out
+
+
 def load_pretrained_resnet(path: str | Path, model, image_size: int = 224):
     """Path → converted ``{"params", "batch_stats"}`` for ``model``.
 

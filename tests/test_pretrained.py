@@ -624,3 +624,122 @@ def test_vit_resolution_mismatch_fails_loudly(tmp_path):
                 dtype=jnp.float32)
     with pytest.raises(ValueError, match="pos_embedding"):
         load_pretrained_vit(path, model, image_size=64)
+
+
+# --------------------------------------------------------------------------
+# Export: Flax variables -> torchvision-layout .npz (the reverse converter)
+# --------------------------------------------------------------------------
+
+def test_export_round_trips_resnet(tmp_path):
+    """export_torchvision(convert(state)) == state for every tensor, and
+    re-loading the export through the forward converter reproduces the
+    original variables exactly (strict round trip)."""
+    from dss_ml_at_scale_tpu.models.pretrained import export_torchvision
+
+    state = tiny_torch_state()
+    model = _tiny_model()
+    variables = convert_torchvision_resnet(
+        state, _template(model), model.stage_sizes
+    )
+    out = tmp_path / "export.npz"
+    exported = export_torchvision(variables, model, out)
+    for k, v in exported.items():
+        np.testing.assert_array_equal(v, state[k], err_msg=k)
+    # num_batches_tracked is load-ignored and export-absent by design.
+    assert not any("num_batches_tracked" in k for k in exported)
+
+    reloaded = load_pretrained_resnet(out, model, image_size=64)
+    a = jax.tree_util.tree_leaves(variables)
+    b = jax.tree_util.tree_leaves(reloaded)
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_export_round_trips_vit(tmp_path):
+    """ViT export re-fuses q/k/v into in_proj_weight/bias; the .npz
+    reloads to identical variables."""
+    torch = pytest.importorskip("torch")
+
+    from dss_ml_at_scale_tpu.models.pretrained import (
+        export_torchvision,
+        load_pretrained_vit,
+    )
+    from dss_ml_at_scale_tpu.models.vit import ViT
+
+    tmodel = _torch_mini_vit(torch)
+    pt = tmp_path / "vit.pt"
+    torch.save(tmodel.state_dict(), pt)
+    model = ViT(num_classes=6, patch=8, dim=32, depth=2, num_heads=2,
+                dtype=jnp.float32)
+    variables = load_pretrained_vit(pt, model, image_size=32)
+
+    out = tmp_path / "vit_export.npz"
+    exported = export_torchvision(variables, model, out)
+    sd = {k: v.detach().numpy() for k, v in tmodel.state_dict().items()}
+    for k, v in exported.items():
+        np.testing.assert_allclose(v, sd[k], rtol=0, atol=1e-6, err_msg=k)
+
+    reloaded = load_pretrained_vit(out, model, image_size=32)
+    for x, y in zip(
+        jax.tree_util.tree_leaves(variables),
+        jax.tree_util.tree_leaves(reloaded),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.slow
+def test_export_cli_round_trip(tmp_path, capsys, devices8):
+    """dsst train (tiny) -> dsst export -> .npz feeds back into
+    dsst train --pretrained: the full both-ways migration loop at the
+    CLI surface."""
+    import json as _json
+
+    import pyarrow as pa
+
+    from test_end_to_end import _jpeg
+
+    from dss_ml_at_scale_tpu.config.cli import main
+    from dss_ml_at_scale_tpu.data import write_delta
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 32)
+    table = pa.table({
+        "content": pa.array([_jpeg(rng, l) for l in labels],
+                            type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    data = tmp_path / "images"
+    write_delta(table, data, max_rows_per_file=16)
+
+    ckpt = tmp_path / "ckpt"
+    # Cosine schedule on purpose: the restore template must be
+    # schedule-shaped (extra count leaf) for export to succeed.
+    assert main([
+        "train", "--data", str(data), "--model", "tiny",
+        "--num-classes", "4", "--crop", "64", "--batch-size", "16",
+        "--epochs", "1", "--checkpoint-dir", str(ckpt),
+        "--lr-schedule", "cosine",
+    ]) == 0
+    capsys.readouterr()
+
+    # Non-.npz out is rejected up front (np.savez would silently write
+    # a different path than the one reported).
+    with pytest.raises(SystemExit, match="npz"):
+        main(["export", "--checkpoint-dir", str(ckpt),
+              "--out", str(tmp_path / "weights.bin")])
+
+    out = tmp_path / "weights.npz"
+    assert main([
+        "export", "--checkpoint-dir", str(ckpt), "--out", str(out),
+    ]) == 0
+    summary = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["tensors"] > 0 and out.exists()
+
+    # The exported layout feeds straight back into --pretrained.
+    assert main([
+        "train", "--data", str(data), "--model", "tiny",
+        "--num-classes", "4", "--crop", "64", "--batch-size", "16",
+        "--epochs", "1", "--pretrained", str(out),
+        "--checkpoint-dir", str(tmp_path / "ckpt2"),
+    ]) == 0
