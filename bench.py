@@ -69,6 +69,7 @@ _FORCE_CPU_ENV = "DSST_BENCH_FORCE_CPU"
 _TIMEOUT_ENV = "DSST_BENCH_TIMEOUT"  # seconds per child attempt
 _GROUP_TIMEOUT_ENV = "DSST_BENCH_GROUP_TIMEOUT"
 _LM_TIMEOUT_ENV = "DSST_BENCH_LM_TIMEOUT"
+_VIT_TIMEOUT_ENV = "DSST_BENCH_VIT_TIMEOUT"
 _PROBE_TIMEOUT_ENV = "DSST_BENCH_PROBE_TIMEOUT"
 _PARTIAL_ENV = "DSST_BENCH_PARTIAL"  # child progress file (resume + salvage)
 
@@ -272,35 +273,52 @@ def parent_main() -> None:
             group = {"error": f"accelerator: {accel_reason}; cpu: {cpu_err}"}
     result["group"] = group
 
+    def _accel_block(mode, t, salvage_key, prev_err):
+        """The attempt → salvage → CPU-fallback → error ladder shared by
+        the lm and vit blocks. ``prev_err`` from the preceding block:
+        its watchdog kill leaves a stale device lease (see the
+        train→group seam), so wait out the observed recovery first."""
+        partial = os.path.join(scratch, f"{mode}.json")
+        res = err = None
+        if accelerator_up:
+            if prev_err is not None and "timed out" in str(prev_err):
+                time.sleep(120.0)
+            res, err = _run_child(mode, force_cpu=False, t=t,
+                                  partial_path=partial)
+            if res is None:
+                res = _salvage(partial, salvage_key)
+                if res is not None:
+                    res["note"] = f"{err}; salvaged on-chip partial"
+        if res is None:
+            res, cpu_err = _run_child(mode, force_cpu=True, t=min(t, 300.0))
+            if res is not None:
+                res["note"] = (
+                    (f"{err}; " if err else "")
+                    + "cpu liveness fallback — numbers not "
+                    "chip-representative"
+                )
+            else:
+                res = {"error": f"accelerator: {err or 'probe failed'}; "
+                                f"cpu: {cpu_err}"}
+        return res, err
+
     # Long-context LM block: flash-attention transformer tokens/sec.
     # Same child/watchdog discipline; CPU fallback shrinks the model to a
     # liveness check.
-    lt = float(os.environ.get(_LM_TIMEOUT_ENV, "600"))
-    lm_partial = os.path.join(scratch, "lm.json")
-    lm = lerr = None
-    if accelerator_up:
-        if gerr is not None and "timed out" in str(gerr):
-            # A killed group child leaves the same stale device lease the
-            # train->group seam guards against; give it the observed
-            # recovery time or the lm child hangs on it too.
-            time.sleep(120.0)
-        lm, lerr = _run_child("lm", force_cpu=False, t=lt,
-                              partial_path=lm_partial)
-        if lm is None:
-            lm = _salvage(lm_partial, "tokens_per_sec")
-            if lm is not None:
-                lm["note"] = f"{lerr}; salvaged on-chip partial"
-    if lm is None:
-        lm, cpu_lerr = _run_child("lm", force_cpu=True, t=min(lt, 300.0))
-        if lm is not None:
-            lm["note"] = (
-                (f"{lerr}; " if lerr else "")
-                + "cpu liveness fallback — numbers not chip-representative"
-            )
-        else:
-            lm = {"error": f"accelerator: {lerr or 'probe failed'}; "
-                           f"cpu: {cpu_lerr}"}
+    lm, lerr = _accel_block(
+        "lm", float(os.environ.get(_LM_TIMEOUT_ENV, "600")),
+        "tokens_per_sec", prev_err=gerr,
+    )
     result["lm"] = lm
+
+    # Opt-in ViT-S/16 block (our artifact chain sets DSST_BENCH_VIT=1;
+    # the driver's lean run skips it).
+    if os.environ.get("DSST_BENCH_VIT"):
+        vit, _verr = _accel_block(
+            "vit", float(os.environ.get(_VIT_TIMEOUT_ENV, "900")),
+            "images_per_sec", prev_err=lerr,
+        )
+        result["vit"] = vit
 
     import shutil
 
@@ -1098,6 +1116,84 @@ def child_lm() -> None:
     print(json.dumps(result))
 
 
+def child_vit() -> None:
+    """Opt-in second-family block (DSST_BENCH_VIT=1): ViT-S/16 train
+    step images/sec + MFU at one batch.
+
+    ViT is the architecture the MXU likes best — pure matmuls, no
+    BatchNorm byte traffic — so its on-chip rate next to ResNet-50's
+    quantifies how much of the headline gap is the model, not the
+    framework. Same watchdog/partial discipline as the other children.
+    """
+    result: dict = {"failed": False}
+    try:
+        import jax
+        import optax
+
+        _enable_compile_cache(jax)
+        if os.environ.get(_FORCE_CPU_ENV):
+            jax.config.update("jax_platforms", "cpu")
+
+        device_kind = jax.devices()[0].device_kind
+        on_accel = jax.devices()[0].platform != "cpu"
+        result["platform"] = jax.devices()[0].platform
+        result["device"] = device_kind
+
+        from dss_ml_at_scale_tpu.models import ViT, vit_s16
+        from dss_ml_at_scale_tpu.parallel import ClassifierTask
+        from dss_ml_at_scale_tpu.utils.benchlib import (
+            synthetic_image_batch_device,
+            timed_train_steps,
+        )
+
+        import jax.numpy as jnp
+
+        if on_accel:
+            model, batch_size, image, steps = vit_s16(1000), 256, 224, 10
+        else:
+            model = ViT(num_classes=10, patch=8, dim=32, depth=2,
+                        num_heads=2, dtype=jnp.float32)
+            batch_size, image, steps = 8, 32, 2
+        result.update(model="vit_s16" if on_accel else "vit_micro",
+                      batch=batch_size, image=image)
+
+        task = ClassifierTask(model=model, tx=optax.adam(1e-4))
+        device_batch = synthetic_image_batch_device(
+            batch_size, image, num_classes=model.num_classes
+        )
+        state = task.init_state(jax.random.key(0), device_batch)
+        compiled = jax.jit(task.train_step, donate_argnums=0).lower(
+            state, device_batch
+        ).compile()
+        flops_per_step = _xla_cost(compiled).get("flops_per_step", 0.0)
+        peak = PEAK_BF16_FLOPS.get(device_kind)
+
+        def _record(ips: float, note: str | None = None) -> None:
+            result["images_per_sec"] = round(ips, 2)
+            if flops_per_step and peak:
+                result["mfu"] = round(
+                    flops_per_step * (ips / batch_size) / peak, 4
+                )
+            if note:
+                result["window"] = note
+
+        # Coarse window first, checkpointed — a watchdog kill during the
+        # full window still salvages a real on-chip rate (same
+        # discipline as child_lm).
+        state2, dt = timed_train_steps(compiled, state, device_batch, 2)
+        _record(batch_size * 2 / dt, "coarse (2 steps)")
+        _save_partial(result)
+        _, dt = timed_train_steps(compiled, state2, device_batch, steps,
+                                  warmup=0)
+        _record(batch_size * steps / dt)
+        result.pop("window", None)
+        _save_partial(result)
+    except Exception:
+        result["failed"] = True
+        result["note"] = traceback.format_exc(limit=5)
+    print(json.dumps(result))
+
+
 def child_probe() -> None:
     """Claim the default backend and report it — nothing else. The parent
     uses this (under a short watchdog) to decide whether the accelerator
@@ -1131,6 +1227,8 @@ if __name__ == "__main__":
             child_group()
         elif mode == "lm":
             child_lm()
+        elif mode == "vit":
+            child_vit()
         elif mode == "probe":
             child_probe()
         else:

@@ -121,3 +121,12 @@ def test_parent_salvages_partial_over_cpu_fallback(tmp_path):
         assert json.loads(path.read_text())["value"] == 1.0
     finally:
         os.environ.pop("DSST_BENCH_PARTIAL", None)
+
+
+@pytest.mark.slow
+def test_vit_child_measures_images_per_sec():
+    out = _run_child("vit", timeout=420)
+    assert not out.get("failed"), out.get("note")
+    assert out["platform"] == "cpu"
+    assert out["model"] == "vit_micro"
+    assert out["images_per_sec"] > 0

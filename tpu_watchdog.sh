@@ -26,7 +26,8 @@ PY
     echo "$(date -u +%H:%M:%S) tunnel up -> bench" >> tpu_watchdog.log
     sleep 10
     DSST_BENCH_TIMEOUT=2400 DSST_BENCH_GROUP_TIMEOUT=1500 DSST_BENCH_LM_TIMEOUT=1200 \
-      timeout 10800 python bench.py > BENCH_onchip_r4.json 2> bench_onchip_stderr.log
+      DSST_BENCH_VIT=1 \
+      timeout 14400 python bench.py > BENCH_onchip_r4.json 2> bench_onchip_stderr.log
     echo "$(date -u +%H:%M:%S) bench rc=$?" >> tpu_watchdog.log
     timeout 2400 python bench_accuracy.py --out ACCURACY_onchip_r4.json >> tpu_watchdog.log 2>&1
     echo "$(date -u +%H:%M:%S) accuracy rc=$?" >> tpu_watchdog.log
