@@ -1363,6 +1363,52 @@ def _cmd_export(args: argparse.Namespace) -> int:
     return 0
 
 
+def register_serve(sub: argparse._SubParsersAction) -> None:
+    sv = sub.add_parser(
+        "serve",
+        help="HTTP inference server over a trained checkpoint: "
+        "GET /healthz, POST /predict (raw JPEG body or JSON "
+        '{"instances": ["<base64 jpeg>", ...]}); one fixed-shape '
+        "compiled scorer, label names from the trained vocabulary",
+    )
+    sv.add_argument("--checkpoint-dir", required=True,
+                    help="a dsst train checkpoint dir (dsst_model.json)")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8008)
+    sv.add_argument("--step", type=int, default=None,
+                    help="explicit checkpoint step (default: best, else latest)")
+    sv.add_argument("--micro-batch", type=int, default=8,
+                    help="compiled scoring batch; requests pad/chunk to it")
+    sv.set_defaults(fn=_cmd_serve)
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    from ..workloads.serving import Predictor, make_server
+
+    try:
+        predictor = Predictor(args.checkpoint_dir, step=args.step,
+                              micro_batch=args.micro_batch)
+    except FileNotFoundError:
+        # _checkpoint_task already printed the diagnosis; exit like
+        # predict/export do instead of dying with a traceback.
+        return 1
+    server = make_server(predictor, args.host, args.port)
+    host, port = server.server_address[:2]
+    print(json.dumps({
+        "serving": f"http://{host}:{port}",
+        "model": predictor.meta.get("model"),
+        "checkpoint_step": predictor.step,
+        "crop": predictor.crop,
+    }), flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
 def register_all(sub: argparse._SubParsersAction) -> None:
     register_datagen(sub)
     register_forecast(sub)
@@ -1371,6 +1417,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_train(sub)
     register_predict(sub)
     register_export(sub)
+    register_serve(sub)
     register_lm(sub)
     register_hpo(sub)
     register_trial_worker(sub)

@@ -1,0 +1,182 @@
+"""HTTP inference serving for trained checkpoints (`dsst serve`).
+
+The reference's deployment story ends at the Databricks platform
+(model serving endpoints); this is the plain-filesystem equivalent: a
+stdlib ``ThreadingHTTPServer`` in front of a compiled scoring function.
+
+Design points (TPU-shaped):
+
+- **One executable, fixed shapes**: the scorer compiles ONCE at a fixed
+  micro-batch; requests are padded up to it (and chunked above it), so
+  no request shape ever triggers a recompile — the latency profile is
+  flat after warmup.
+- **Same decode, same normalization**: images go through the training
+  transform path (``decode_resize_crop`` + the task's normalization
+  constants), and class names come from the label vocabulary persisted
+  WITH the checkpoint — predictions match ``dsst predict`` bit for bit.
+- **Endpoints**: ``GET /healthz`` (model/step/status), ``POST /predict``
+  with either a raw JPEG body (``Content-Type: image/jpeg``) or JSON
+  ``{"instances": ["<base64 jpeg>", ...]}`` → JSON
+  ``{"predictions": [{"pred_index", "pred_prob", "pred_label"}, ...]}``.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+
+class Predictor:
+    """Checkpoint → compiled fixed-batch scorer."""
+
+    def __init__(self, checkpoint_dir: str, *, step: int | None = None,
+                 micro_batch: int = 8):
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..config.commands import _checkpoint_task
+        from ..parallel import restore_state
+
+        resolved = _checkpoint_task(checkpoint_dir)
+        if resolved is None:
+            raise FileNotFoundError(
+                f"no dsst_model.json under {checkpoint_dir}"
+            )
+        self.meta, self.crop, model, task = resolved
+        self.micro_batch = int(micro_batch)
+        self.label_names = self.meta.get("label_names")
+        # THE training/predict transform (same resize-256 field of view,
+        # same normalization, same decode backend) — serving must score
+        # the pixels the model was trained on, so the decode path is
+        # shared, not re-implemented.
+        from ..data.transform import imagenet_transform_spec
+
+        self._spec = imagenet_transform_spec(crop=self.crop)
+
+        sample = {
+            "image": np.zeros((1, self.crop, self.crop, 3), np.float32),
+            "label": np.zeros((1,), np.int32),
+        }
+        state, self.step = restore_state(
+            task, sample, checkpoint_dir, step=step
+        )
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        state = None  # free the optimizer state before serving
+
+        def score(images):  # [micro_batch, crop, crop, 3] normalized
+            logits = model.apply(variables, images, train=False)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            return jnp.argmax(probs, axis=-1), jnp.max(probs, axis=-1)
+
+        self._score = jax.jit(score)
+        self._jnp = jnp
+        self._np = np
+        # Warm the one executable so the first request pays no compile.
+        self._score(
+            jnp.zeros((self.micro_batch, self.crop, self.crop, 3),
+                      jnp.float32)
+        )
+
+    def predict(self, jpegs: list[bytes]) -> list[dict]:
+        """Decoded, padded, chunked scoring of a request's images."""
+        np, jnp = self._np, self._jnp
+        content = np.empty(len(jpegs), object)
+        content[:] = jpegs
+        cols = self._spec({
+            "content": content,
+            "label_index": np.zeros(len(jpegs), np.int64),
+        })
+        images = cols["image"]
+        out: list[dict] = []
+        for lo in range(0, len(images), self.micro_batch):
+            chunk = images[lo:lo + self.micro_batch]
+            n = len(chunk)
+            if n < self.micro_batch:  # pad to the compiled shape
+                chunk = np.concatenate(
+                    [chunk, np.zeros(
+                        (self.micro_batch - n, *chunk.shape[1:]),
+                        chunk.dtype,
+                    )]
+                )
+            idx, prob = self._score(jnp.asarray(chunk))
+            # One host fetch per output per chunk, not per image.
+            idx, prob = np.asarray(idx), np.asarray(prob)
+            for i in range(n):
+                k = int(idx[i])
+                row = {"pred_index": k, "pred_prob": float(prob[i])}
+                if self.label_names and 0 <= k < len(self.label_names):
+                    row["pred_label"] = self.label_names[k]
+                out.append(row)
+        return out
+
+
+def make_server(predictor: Predictor, host: str = "127.0.0.1",
+                port: int = 8008) -> ThreadingHTTPServer:
+    """A ready-to-run server (caller picks ``serve_forever`` vs thread)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet by default; errors still raise
+            pass
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._json(200, {
+                    "status": "ok",
+                    "model": predictor.meta.get("model"),
+                    "checkpoint_step": predictor.step,
+                    "crop": predictor.crop,
+                })
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._json(404, {"error": f"no route {self.path}"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            try:
+                if self.headers.get("Content-Type", "").startswith(
+                    "application/json"
+                ):
+                    payload = json.loads(body)
+                    jpegs = [
+                        base64.b64decode(x) for x in payload["instances"]
+                    ]
+                else:
+                    jpegs = [body]  # raw single JPEG
+                if not jpegs:
+                    raise ValueError("empty instances")
+                preds = predictor.predict(jpegs)
+            except Exception as e:  # malformed input must not kill serving
+                self._json(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._json(200, {"predictions": preds})
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def serve_in_thread(predictor: Predictor, host: str = "127.0.0.1",
+                    port: int = 0):
+    """(server, thread) with the server already running — the test and
+    embedding entry point; ``port=0`` picks a free port
+    (``server.server_address[1]``)."""
+    server = make_server(predictor, host, port)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread

@@ -1,0 +1,171 @@
+"""HTTP inference serving (workloads/serving.py, `dsst serve`).
+
+The platform-deployment face (reference users get this from Databricks
+model serving): a trained checkpoint behind GET /healthz + POST
+/predict, one fixed-shape compiled scorer, vocabulary label names.
+"""
+
+import base64
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def trained_ckpt(tmp_path_factory, devices8):
+    """A tiny trained checkpoint over real JPEGs, with a label
+    vocabulary — shared by every serving test."""
+    import pyarrow as pa
+
+    from test_end_to_end import _jpeg
+
+    from dss_ml_at_scale_tpu.config.cli import main
+    from dss_ml_at_scale_tpu.data import write_delta
+
+    root = tmp_path_factory.mktemp("serve")
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 48)
+    jpegs = [_jpeg(rng, l) for l in labels]
+    table = pa.table({
+        "content": pa.array(jpegs, type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    data = root / "images"
+    write_delta(table, data, max_rows_per_file=16)
+    # A vocabulary like dsst ingest writes; train persists it with the
+    # checkpoint, and serve must name classes from it.
+    (data / "labels.json").write_text(
+        json.dumps({"cat": 0, "dog": 1, "fox": 2, "owl": 3})
+    )
+
+    ckpt = root / "ckpt"
+    assert main([
+        "train", "--data", str(data), "--model", "tiny",
+        "--num-classes", "4", "--crop", "64", "--batch-size", "16",
+        "--epochs", "1", "--checkpoint-dir", str(ckpt),
+    ]) == 0
+    return ckpt, jpegs
+
+
+@pytest.fixture(scope="module")
+def server(trained_ckpt):
+    from dss_ml_at_scale_tpu.workloads.serving import (
+        Predictor,
+        serve_in_thread,
+    )
+
+    ckpt, jpegs = trained_ckpt
+    predictor = Predictor(str(ckpt), micro_batch=4)
+    srv, _thread = serve_in_thread(predictor)
+    yield srv.server_address[1], jpegs
+    srv.shutdown()
+    srv.server_close()
+
+
+def _request(port, method, path, body=None, content_type=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    headers = {"Content-Type": content_type} if content_type else {}
+    conn.request(method, path, body=body, headers=headers)
+    resp = conn.getresponse()
+    payload = json.loads(resp.read())
+    conn.close()
+    return resp.status, payload
+
+
+def test_healthz(server):
+    port, _ = server
+    status, payload = _request(port, "GET", "/healthz")
+    assert status == 200
+    assert payload["status"] == "ok"
+    assert payload["model"] == "tiny"
+    assert payload["crop"] == 64
+
+
+def test_predict_raw_jpeg(server):
+    port, jpegs = server
+    status, payload = _request(
+        port, "POST", "/predict", body=jpegs[0],
+        content_type="image/jpeg",
+    )
+    assert status == 200
+    (pred,) = payload["predictions"]
+    assert 0 <= pred["pred_index"] < 4
+    assert 0.0 < pred["pred_prob"] <= 1.0
+    assert pred["pred_label"] in {"cat", "dog", "fox", "owl"}
+
+
+def test_predict_json_batch_pads_and_chunks(server):
+    port, jpegs = server
+    # 7 instances through a micro_batch-4 scorer: one full chunk + one
+    # padded chunk, order preserved.
+    body = json.dumps(
+        {"instances": [base64.b64encode(j).decode() for j in jpegs[:7]]}
+    )
+    status, payload = _request(
+        port, "POST", "/predict", body=body,
+        content_type="application/json",
+    )
+    assert status == 200
+    assert len(payload["predictions"]) == 7
+    # Same images one at a time agree with the batched pass (padding
+    # must not leak into real rows).
+    for i in (0, 4, 6):
+        status, single = _request(
+            port, "POST", "/predict", body=jpegs[i],
+            content_type="image/jpeg",
+        )
+        assert single["predictions"][0] == payload["predictions"][i]
+
+
+def test_malformed_input_is_400_not_fatal(server):
+    port, jpegs = server
+    status, payload = _request(
+        port, "POST", "/predict", body=b"{not json",
+        content_type="application/json",
+    )
+    assert status == 400 and "error" in payload
+    status, payload = _request(
+        port, "POST", "/predict",
+        body=json.dumps({"instances": []}),
+        content_type="application/json",
+    )
+    assert status == 400
+    # The server survives bad requests and keeps serving.
+    status, _ = _request(port, "GET", "/healthz")
+    assert status == 200
+
+
+def test_unknown_route_404(server):
+    port, _ = server
+    assert _request(port, "GET", "/nope")[0] == 404
+    assert _request(port, "POST", "/nope")[0] == 404
+
+
+def test_serving_matches_dsst_predict(server, trained_ckpt, tmp_path):
+    """The guarantee the module docstring makes: the server scores the
+    SAME pixels as dsst predict (shared transform spec — resize-256
+    field of view, normalization, decode backend), so pred_index agrees
+    row for row."""
+    from dss_ml_at_scale_tpu.config.cli import main
+    from dss_ml_at_scale_tpu.config.commands import _read_delta_pandas
+
+    port, jpegs = server
+    ckpt, _ = trained_ckpt
+    data = ckpt.parent / "images"
+    out = tmp_path / "preds"
+    assert main([
+        "predict", "--data", str(data), "--checkpoint-dir", str(ckpt),
+        "--out", str(out), "--batch-size", "16",
+    ]) == 0
+    table_preds = _read_delta_pandas(out).sort_values("row")
+
+    for i in (0, 7, 23):
+        status, payload = _request(
+            port, "POST", "/predict", body=jpegs[i],
+            content_type="image/jpeg",
+        )
+        assert status == 200
+        served = payload["predictions"][0]
+        assert served["pred_index"] == int(table_preds["pred_index"].iloc[i])
