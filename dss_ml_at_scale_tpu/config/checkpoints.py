@@ -1,0 +1,116 @@
+"""Checkpoint-meta resolution shared by predict / export / serve.
+
+``dsst train`` persists ``dsst_model.json`` beside its orbax steps;
+every consumer (CLI commands and the serving library) resolves it
+through this ONE module, so restore-critical branches — the
+schedule-shaped optimizer template, fused-BN fidelity, the ViT
+training-crop pin — cannot drift between entry points. Library
+semantics: failures RAISE (``FileNotFoundError`` / ``ValueError``);
+the CLI layer turns them into prints and exit codes.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+
+def build_classifier_model(name: str, *, num_classes: int,
+                           torch_padding: bool, fused_bn: bool = True):
+    """The train/predict/export/serve-shared model factory
+    ("resnet50" | "tiny" | "vit-t16" | "vit-s16" | "vit-tiny")."""
+    if name.startswith("vit"):
+        # torch_padding / fused_bn are conv/BN concepts; a ViT has
+        # neither, so the flags are inert for these choices.
+        from ..models import ViT, vit_s16, vit_t16
+
+        if name == "vit-t16":
+            return vit_t16(num_classes)
+        if name == "vit-s16":
+            return vit_s16(num_classes)
+        # "vit-tiny": a CI-sized geometry (patch 8 suits small crops).
+        return ViT(num_classes=num_classes, patch=8, dim=32, depth=2,
+                   num_heads=2)
+    from ..models import ResNet50
+
+    if name == "resnet50":
+        return ResNet50(num_classes=num_classes, torch_padding=torch_padding,
+                        fused_bn=fused_bn)
+    from ..models.resnet import ResNet, ResNetBlock
+
+    return ResNet(
+        stage_sizes=[1, 1], block_cls=ResNetBlock,
+        num_classes=num_classes, num_filters=8,
+        torch_padding=torch_padding, fused_bn=fused_bn,
+    )
+
+
+def resolve_checkpoint(checkpoint_dir, crop_override: int | None = None):
+    """``(meta, crop, model, task)`` for a dsst-train checkpoint.
+
+    Raises ``FileNotFoundError`` when the directory carries no
+    ``dsst_model.json`` and ``ValueError`` when a crop override fights
+    a ViT's training crop (its position table is sized by it; a
+    different scoring crop would surface as a raw orbax structure
+    mismatch — ResNet pools globally and tolerates the override).
+    """
+    meta_path = Path(checkpoint_dir) / "dsst_model.json"
+    if not meta_path.exists():
+        raise FileNotFoundError(
+            f"no dsst_model.json under {checkpoint_dir}; "
+            "was this checkpoint written by dsst train?"
+        )
+    meta = json.loads(meta_path.read_text())
+    crop = crop_override or int(meta.get("crop", 224))
+    if (
+        str(meta.get("model", "")).startswith("vit")
+        and meta.get("crop")
+        and crop != int(meta["crop"])
+    ):
+        raise ValueError(
+            f"--crop {crop} differs from the training crop "
+            f"{meta['crop']}: ViT checkpoints must be scored at the "
+            "crop they were trained with"
+        )
+    from ..parallel import ClassifierTask
+
+    model = build_classifier_model(
+        meta.get("model", "resnet50"),
+        num_classes=int(meta["num_classes"]),
+        torch_padding=bool(meta.get("torch_padding", False)),
+        # Eval-mode math is identical either way; rebuild what was
+        # trained for fidelity (older checkpoints predate the flag).
+        fused_bn=bool(meta.get("fused_bn", False)),
+    )
+    if meta.get("lr_schedule", "constant") == "cosine":
+        # restore_state structure-matches the FULL TrainState, optimizer
+        # included; a scheduled adam stores an extra count leaf, so the
+        # template's tx must be schedule-shaped too (the schedule's
+        # values are irrelevant to inference).
+        import optax
+
+        task = ClassifierTask(
+            model=model, tx=optax.adam(optax.constant_schedule(1e-5))
+        )
+    else:
+        task = ClassifierTask(model=model)
+    return meta, crop, model, task
+
+
+def make_scorer(task, variables):
+    """The ONE jitted classification scorer: images → (pred_index,
+    pred_prob). Shared by ``dsst predict`` and the HTTP server, so
+    their outputs agree by construction. Accepts whatever the task's
+    ``_images`` accepts (float NHWC, uint8, or NCHW)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def score(images):
+        logits = task.model.apply(
+            variables, task._images({task.image_key: images}), train=False
+        )
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.argmax(probs, axis=-1), jnp.max(probs, axis=-1)
+
+    return score

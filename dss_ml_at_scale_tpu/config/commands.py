@@ -632,34 +632,10 @@ def _has_checkpoint(args: argparse.Namespace) -> bool:
 # predict (beyond parity: score a Delta table with a trained checkpoint)
 # --------------------------------------------------------------------------
 
-def _build_classifier_model(name: str, *, num_classes: int,
-                            torch_padding: bool, fused_bn: bool = True):
-    """The train/predict-shared model factory
-    ("resnet50" | "tiny" | "vit-t16" | "vit-s16" | "vit-tiny")."""
-    if name.startswith("vit"):
-        # torch_padding / fused_bn are conv/BN concepts; a ViT has
-        # neither, so the flags are inert for these choices.
-        from ..models import ViT, vit_s16, vit_t16
+def _build_classifier_model(name, **kw):
+    from .checkpoints import build_classifier_model
 
-        if name == "vit-t16":
-            return vit_t16(num_classes)
-        if name == "vit-s16":
-            return vit_s16(num_classes)
-        # "vit-tiny": a CI-sized geometry (patch 8 suits small crops).
-        return ViT(num_classes=num_classes, patch=8, dim=32, depth=2,
-                   num_heads=2)
-    from ..models import ResNet50
-
-    if name == "resnet50":
-        return ResNet50(num_classes=num_classes, torch_padding=torch_padding,
-                        fused_bn=fused_bn)
-    from ..models.resnet import ResNet, ResNetBlock
-
-    return ResNet(
-        stage_sizes=[1, 1], block_cls=ResNetBlock,
-        num_classes=num_classes, num_filters=8,
-        torch_padding=torch_padding, fused_bn=fused_bn,
-    )
+    return build_classifier_model(name, **kw)
 
 
 def register_predict(sub: argparse._SubParsersAction) -> None:
@@ -690,58 +666,19 @@ def register_predict(sub: argparse._SubParsersAction) -> None:
 
 
 def _checkpoint_task(checkpoint_dir, crop_override=None):
-    """(meta, crop, model, task) for a dsst-train checkpoint — the one
-    meta-reading path shared by predict and export, so restore-critical
-    branches (schedule-shaped optimizer, fused-BN fidelity, the ViT
-    crop pin) cannot drift between the two commands.
-
-    Prints the missing-meta diagnosis and returns None if the directory
-    carries no ``dsst_model.json`` (callers just ``return 1``).
+    """CLI face of :func:`..config.checkpoints.resolve_checkpoint`:
+    prints the missing-meta diagnosis and returns None (callers just
+    ``return 1``); a crop/architecture conflict exits with the message.
     """
-    meta_path = Path(checkpoint_dir) / "dsst_model.json"
-    if not meta_path.exists():
-        print(f"no dsst_model.json under {checkpoint_dir}; "
-              "was this checkpoint written by dsst train?")
+    from .checkpoints import resolve_checkpoint
+
+    try:
+        return resolve_checkpoint(checkpoint_dir, crop_override)
+    except FileNotFoundError as e:
+        print(e)
         return None
-    meta = json.loads(meta_path.read_text())
-    crop = crop_override or int(meta.get("crop", 224))
-    if (
-        str(meta.get("model", "")).startswith("vit")
-        and meta.get("crop")
-        and crop != int(meta["crop"])
-    ):
-        # A ViT's position table is sized by the training crop; a
-        # different scoring crop would fail deep in the orbax restore
-        # with a raw structure mismatch. (ResNet pools globally and
-        # tolerates the override.)
-        raise SystemExit(
-            f"--crop {crop} differs from the training crop "
-            f"{meta['crop']}: ViT checkpoints must be scored at the "
-            "crop they were trained with"
-        )
-    from ..parallel import ClassifierTask
-
-    model = _build_classifier_model(
-        meta.get("model", "resnet50"),
-        num_classes=int(meta["num_classes"]),
-        torch_padding=bool(meta.get("torch_padding", False)),
-        # Eval-mode math is identical either way; rebuild what was
-        # trained for fidelity (older checkpoints predate the flag).
-        fused_bn=bool(meta.get("fused_bn", False)),
-    )
-    if meta.get("lr_schedule", "constant") == "cosine":
-        # restore_state structure-matches the FULL TrainState, optimizer
-        # included; a scheduled adam stores an extra count leaf, so the
-        # template's tx must be schedule-shaped too (the schedule's
-        # values are irrelevant to inference).
-        import optax
-
-        task = ClassifierTask(
-            model=model, tx=optax.adam(optax.constant_schedule(1e-5))
-        )
-    else:
-        task = ClassifierTask(model=model)
-    return meta, crop, model, task
+    except ValueError as e:
+        raise SystemExit(str(e))
 
 
 def _cmd_predict(args: argparse.Namespace) -> int:
@@ -788,16 +725,13 @@ def _cmd_predict(args: argparse.Namespace) -> int:
                 variables = {"params": params}
                 if batch_stats:  # stat-free models (ViT) have none
                     variables["batch_stats"] = batch_stats
+                from .checkpoints import make_scorer
 
-                @jax.jit
-                def predict(batch):
-                    logits = model.apply(
-                        variables, task._images(batch), train=False,
-                    )
-                    probs = jax.nn.softmax(logits.astype("float32"), axis=-1)
-                    return jnp.argmax(probs, axis=-1), jnp.max(probs, axis=-1)
+                # The SAME jitted scorer dsst serve uses — parity by
+                # construction, not by parallel maintenance.
+                predict = make_scorer(task, variables)
 
-            pred, prob = predict(batch)
+            pred, prob = predict(batch["image"])
             pred, prob = np.asarray(pred), np.asarray(prob)
             labels = np.asarray(batch["label"])
             rows_label.append(labels)
@@ -884,6 +818,13 @@ def register_lm(sub: argparse._SubParsersAction) -> None:
     )
     lm.add_argument("--seed", type=int, default=0)
     lm.add_argument("--limit-val-batches", type=int, default=5)
+    lm.add_argument(
+        "--sample", type=int, default=0, metavar="N",
+        help="after training, greedy-generate N tokens from the trained "
+        "model (KV-cached decode) and report the mean TRUE-chain "
+        "probability of the generated transitions - an end-to-end "
+        "sanity number (uniform chance is 1/vocab)",
+    )
     lm.add_argument(
         "--lr-schedule", choices=["constant", "cosine"], default=None,
         help="cosine: linear warmup then cosine decay to 0 over the "
@@ -1002,18 +943,49 @@ def _cmd_lm(args: argparse.Namespace) -> int:
     )
     _finish_tracker(tracker)
     last = result.history[-1] if result.history else {}
-    print(
-        json.dumps(
-            {
-                "steps": int(result.state.step),
-                "train_loss": last.get("train_loss"),
-                "val_loss": last.get("val_loss"),
-                "val_ppl": last.get("val_ppl"),
-                "entropy_floor_nats": round(floor, 4),
-                "best_checkpoint": result.best_checkpoint_path,
-            }
-        )
-    )
+    summary = {
+        "steps": int(result.state.step),
+        "train_loss": last.get("train_loss"),
+        "val_loss": last.get("val_loss"),
+        "val_ppl": last.get("val_ppl"),
+        "entropy_floor_nats": round(floor, 4),
+        "best_checkpoint": result.best_checkpoint_path,
+    }
+    if args.sample > 0:
+        # KV-cached greedy decode from the trained weights; scored
+        # against the TRUE chain (the generator is the fixture, so the
+        # sampled continuation has a computable quality number).
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from ..datagen.tokens import transition_matrix
+        from ..models import generate
+
+        if args.seq <= 4:
+            raise SystemExit(
+                "--sample needs --seq > 4 (4 prompt tokens + at least "
+                "one generated token must fit in max_seq)"
+            )
+        first = next(token_batches(
+            stream, num_batches=1, sample_seed=args.seed + 200_000
+        ))
+        prompt = jnp.asarray(first["tokens"][:1, :4], jnp.int32)
+        n = min(args.sample, args.seq - 4)
+        if n < args.sample:
+            summary["sample_truncated_to"] = n
+        out = np.asarray(generate(
+            model, {"params": result.state.params}, prompt, n_tokens=n
+        ))
+        t = transition_matrix(stream)
+        probs = [
+            float(t[int(out[0, i]), int(out[0, i + 1])])
+            for i in range(3, out.shape[1] - 1)
+        ]
+        summary["sample_tokens"] = out[0].tolist()
+        summary["sample_mean_true_prob"] = round(float(np.mean(probs)), 4)
+        summary["sample_chance_prob"] = round(1.0 / args.vocab, 4)
+    print(json.dumps(summary))
     return 0
 
 
@@ -1388,9 +1360,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     try:
         predictor = Predictor(args.checkpoint_dir, step=args.step,
                               micro_batch=args.micro_batch)
-    except FileNotFoundError:
-        # _checkpoint_task already printed the diagnosis; exit like
-        # predict/export do instead of dying with a traceback.
+    except FileNotFoundError as e:
+        # Missing dsst_model.json OR missing orbax steps: print the
+        # diagnosis and exit like predict/export, no traceback.
+        print(e)
         return 1
     server = make_server(predictor, args.host, args.port)
     host, port = server.server_address[:2]

@@ -77,7 +77,13 @@ class TransformerBlock(nn.Module):
     router_noise: float = 0.0
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(self, x, *, deterministic: bool = True, cache=None,
+                 pos=None):
+        """Full-context training/eval pass, or — with ``cache``/``pos``
+        — one KV-cached decode step (``x`` is then [b, 1, dim] and the
+        return is ``(x, new_cache)``). Both branches call the SAME
+        submodules in the SAME order, so the parameter tree is
+        identical and trained checkpoints decode without conversion."""
         if self.ffn not in ("dense", "moe"):
             raise ValueError(f"unknown ffn {self.ffn!r}: expected 'dense' or 'moe'")
         if self.ffn == "moe" and self.num_experts < 1:
@@ -92,7 +98,30 @@ class TransformerBlock(nn.Module):
         def heads(t):  # [b, s, dim] -> [b, heads, s, head_dim]
             return t.reshape(b, s, self.num_heads, head_dim).transpose(0, 2, 1, 3)
 
-        attn = self.attention_fn(heads(q), heads(k), heads(v))
+        if cache is not None:
+            # Decode step: write this token's k/v at ``pos``, attend the
+            # single query over the cache with a <= pos mask. Plain
+            # einsums — at q_len 1 there is nothing for a kernel to tile.
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], heads(k), pos, axis=2
+            )
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], heads(v), pos, axis=2
+            )
+            scores = jnp.einsum(
+                "bhqd,bhkd->bhqk", heads(q), k_cache,
+                preferred_element_type=jnp.float32,
+            ) / jnp.sqrt(head_dim).astype(jnp.float32)
+            mask = jnp.arange(k_cache.shape[2]) <= pos
+            scores = jnp.where(mask[None, None, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum(
+                "bhqk,bhkd->bhqd", probs, v_cache.astype(jnp.float32)
+            ).astype(self.dtype)
+            new_cache = {"k": k_cache, "v": v_cache}
+        else:
+            attn = self.attention_fn(heads(q), heads(k), heads(v))
+            new_cache = None
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, dim)
         x = x + nn.Dense(dim, use_bias=False, dtype=self.dtype, name="proj")(attn)
 
@@ -114,7 +143,7 @@ class TransformerBlock(nn.Module):
             h = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype, name="mlp_up")(h)
             h = nn.gelu(h)
             x = x + nn.Dense(dim, dtype=self.dtype, name="mlp_down")(h)
-        return x
+        return x if cache is None else (x, new_cache)
 
 
 class TransformerLM(nn.Module):
@@ -147,23 +176,40 @@ class TransformerLM(nn.Module):
     router_noise: float = 0.0
 
     @nn.compact
-    def __call__(self, tokens, *, deterministic: bool = True):
-        # [b, s] int32 -> [b, s, vocab] f32 logits
+    def __call__(self, tokens, *, deterministic: bool = True, cache=None,
+                 pos=None):
+        # [b, s] int32 -> [b, s, vocab] f32 logits; with ``cache``/
+        # ``pos``: one KV-cached decode step on [b, 1] tokens, returning
+        # ``(logits[b, vocab], new_cache)`` (see ``generate``).
         b, s = tokens.shape
         if s > self.max_seq:
             raise ValueError(f"seq {s} > max_seq {self.max_seq}")
-        attention_fn = _select_attention(
-            self.attention, mesh=self.mesh, axis_name=self.axis_name
+        decoding = cache is not None
+        if decoding and self.attention == "ring":
+            raise ValueError(
+                "KV-cache decode is single-device; a sequence-sharded "
+                "(ring) model should decode with attention='flash' or "
+                "'reference' on the gathered sequence"
+            )
+        attention_fn = (
+            None if decoding else _select_attention(
+                self.attention, mesh=self.mesh, axis_name=self.axis_name
+            )
         )
         tok = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype, name="tok_embed")
-        pos = self.param(
+        pos_table = self.param(
             "pos_embed",
             nn.initializers.normal(0.02),
             (self.max_seq, self.dim),
         )
-        x = tok(tokens) + pos[None, :s].astype(self.dtype)
+        if decoding:
+            pos_emb = jax.lax.dynamic_slice_in_dim(pos_table, pos, 1)[None]
+        else:
+            pos_emb = pos_table[None, :s]
+        x = tok(tokens) + pos_emb.astype(self.dtype)
+        new_cache = []
         for i in range(self.num_layers):
-            x = TransformerBlock(
+            block = TransformerBlock(
                 num_heads=self.num_heads,
                 dtype=self.dtype,
                 mlp_ratio=self.mlp_ratio,
@@ -175,10 +221,94 @@ class TransformerLM(nn.Module):
                 expert_axis=self.expert_axis,
                 router_noise=self.router_noise,
                 name=f"block_{i}",
-            )(x, deterministic=deterministic)
+            )
+            if decoding:
+                x, layer_cache = block(
+                    x, deterministic=deterministic, cache=cache[i], pos=pos
+                )
+                new_cache.append(layer_cache)
+            else:
+                x = block(x, deterministic=deterministic)
         x = RMSNorm(dtype=self.dtype)(x)
         # Logits in f32 for a stable softmax cross-entropy.
-        return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head")(x)
+        logits = nn.Dense(
+            self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+        )(x)
+        if decoding:
+            return logits[:, 0], tuple(new_cache)
+        return logits
+
+
+def init_kv_cache(model: TransformerLM, batch: int):
+    """Zeroed per-layer K/V buffers sized [b, heads, max_seq, head_dim]."""
+    head_dim = model.dim // model.num_heads
+    shape = (batch, model.num_heads, model.max_seq, head_dim)
+    return tuple(
+        {"k": jnp.zeros(shape, model.dtype), "v": jnp.zeros(shape, model.dtype)}
+        for _ in range(model.num_layers)
+    )
+
+
+def generate(
+    model: TransformerLM,
+    variables,
+    prompt: jax.Array,  # [b, p] int32
+    n_tokens: int,
+    *,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Autoregressive sampling: ``[b, p + n_tokens]`` continuations.
+
+    One ``lax.scan`` over prompt-prefill AND sampling — every step is
+    the same KV-cached decode program (static shapes, one compile),
+    feeding prompt tokens while ``t < p`` and sampled tokens after.
+    ``temperature=0`` is greedy argmax; otherwise softmax sampling at
+    the given temperature, optionally truncated to the ``top_k`` most
+    likely tokens. The training-side long-context machinery (flash/
+    ring) is for the parallel pass; decode is sequential by nature and
+    runs O(max_seq) attention per token against the cache.
+    """
+    b, p = prompt.shape
+    total = p + int(n_tokens)
+    if total > model.max_seq:
+        raise ValueError(f"prompt + n_tokens = {total} > max_seq {model.max_seq}")
+    if rng is None:
+        rng = jax.random.key(0)
+
+    def step(carry, t):
+        cache, tok_prev, key = carry
+        tok_in = jnp.where(
+            t < p,
+            jax.lax.dynamic_index_in_dim(
+                prompt, jnp.minimum(t, p - 1), axis=1, keepdims=False
+            ),
+            tok_prev,
+        )
+        logits, cache = model.apply(
+            variables, tok_in[:, None], cache=cache, pos=t
+        )
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits, axis=-1)
+        else:
+            scaled = logits / temperature
+            if top_k is not None:
+                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                scaled = jnp.where(scaled < kth, -1e30, scaled)
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, scaled, axis=-1)
+        nxt = nxt.astype(jnp.int32)
+        return (cache, nxt, key), nxt
+
+    cache = init_kv_cache(model, b)
+    (_, _, _), sampled = jax.lax.scan(
+        step, (cache, prompt[:, 0], rng), jnp.arange(total - 1)
+    )
+    # sampled[t] is the prediction AFTER consuming position t; the
+    # continuation is predictions at t = p-1 .. total-2.
+    gen = jnp.swapaxes(sampled[p - 1:], 0, 1)
+    return jnp.concatenate([prompt, gen], axis=1)
 
 
 def next_token_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:

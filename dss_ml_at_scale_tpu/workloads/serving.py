@@ -39,15 +39,12 @@ class Predictor:
         import jax
         import jax.numpy as jnp
 
-        from ..config.commands import _checkpoint_task
+        from ..config.checkpoints import make_scorer, resolve_checkpoint
         from ..parallel import restore_state
 
-        resolved = _checkpoint_task(checkpoint_dir)
-        if resolved is None:
-            raise FileNotFoundError(
-                f"no dsst_model.json under {checkpoint_dir}"
-            )
-        self.meta, self.crop, model, task = resolved
+        self.meta, self.crop, model, task = resolve_checkpoint(
+            checkpoint_dir
+        )
         self.micro_batch = int(micro_batch)
         self.label_names = self.meta.get("label_names")
         # THE training/predict transform (same resize-256 field of view,
@@ -70,12 +67,9 @@ class Predictor:
             variables["batch_stats"] = state.batch_stats
         state = None  # free the optimizer state before serving
 
-        def score(images):  # [micro_batch, crop, crop, 3] normalized
-            logits = model.apply(variables, images, train=False)
-            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-            return jnp.argmax(probs, axis=-1), jnp.max(probs, axis=-1)
-
-        self._score = jax.jit(score)
+        # The SAME jitted scorer dsst predict uses — parity by
+        # construction, not by parallel maintenance.
+        self._score = make_scorer(task, variables)
         self._jnp = jnp
         self._np = np
         # Warm the one executable so the first request pays no compile.
@@ -163,8 +157,16 @@ def make_server(predictor: Predictor, host: str = "127.0.0.1",
                 if not jpegs:
                     raise ValueError("empty instances")
                 preds = predictor.predict(jpegs)
-            except Exception as e:  # malformed input must not kill serving
+            except (json.JSONDecodeError, KeyError, TypeError, ValueError,
+                    OSError) as e:
+                # Input-shaped failures (bad JSON, missing keys, broken
+                # base64/JPEG bytes) are the CLIENT's 400 ...
                 self._json(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            except Exception as e:
+                # ... a genuine server-side fault (XLA runtime error,
+                # OOM) is a 500 — and must not kill serving either.
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
                 return
             self._json(200, {"predictions": preds})
 

@@ -748,3 +748,20 @@ def test_resolve_lr_schedule_precedence():
     lr5 = _resolve_lr_schedule(ns("constant"), meta5, total_steps=50)
     assert lr5 == 0.01
     assert meta5 == {"lr_schedule": "constant"}
+
+
+def test_lm_cli_sample(capsys, devices8, tmp_path, monkeypatch):
+    """dsst lm --sample N: trained-model greedy generation scored
+    against the true chain lands in the summary."""
+    monkeypatch.chdir(tmp_path)
+    assert main([
+        "lm", "--vocab", "16", "--dim", "32", "--heads", "4",
+        "--layers", "1", "--seq", "24", "--batch-size", "8",
+        "--epochs", "1", "--steps-per-epoch", "10",
+        "--learning-rate", "0.003", "--concentration", "0.02",
+        "--sample", "8", "--no-tracking",
+    ]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(summary["sample_tokens"]) == 12  # 4 prompt + 8 generated
+    assert 0.0 <= summary["sample_mean_true_prob"] <= 1.0
+    assert summary["sample_chance_prob"] == round(1 / 16, 4)

@@ -1,0 +1,156 @@
+"""KV-cached autoregressive generation (models/transformer.py).
+
+The LM track trains long-context models (flash/ring attention); this
+covers the inference half: a lax.scan decode loop over per-layer K/V
+caches whose parameter tree is IDENTICAL to the training path, so any
+trained checkpoint decodes without conversion.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dss_ml_at_scale_tpu.models import TransformerLM, generate, init_kv_cache
+
+
+def tiny_lm(**kw):
+    cfg = dict(vocab_size=31, dim=32, num_heads=4, num_layers=2,
+               max_seq=24, attention="reference", dtype=jnp.float32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    model = tiny_lm()
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init(jax.random.key(0), tokens)
+    return model, variables
+
+
+def test_decode_step_matches_full_forward(lm_and_params):
+    """The load-bearing parity: stepping tokens one at a time through
+    the KV cache reproduces the full-context causal forward's logits at
+    every position (same params, same math, different program)."""
+    model, variables = lm_and_params
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 10)), jnp.int32)
+
+    full = model.apply(variables, tokens)  # [2, 10, vocab]
+
+    cache = init_kv_cache(model, 2)
+    stepped = []
+    for t in range(10):
+        logits, cache = model.apply(
+            variables, tokens[:, t:t + 1], cache=cache, pos=t
+        )
+        stepped.append(logits)
+    stepped = jnp.stack(stepped, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(stepped), np.asarray(full), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_greedy_generate_matches_argmax_chain(lm_and_params):
+    """temperature=0 generation equals manually chaining argmax through
+    repeated FULL-context forwards — proving prefill, cache reuse, and
+    the prompt/sample seam agree with the definitionally-correct path."""
+    model, variables = lm_and_params
+    prompt = jnp.asarray([[3, 7, 1]], jnp.int32)
+    out = generate(model, variables, prompt, n_tokens=5)
+    assert out.shape == (1, 8)
+    assert np.array_equal(np.asarray(out[:, :3]), np.asarray(prompt))
+
+    seq = prompt
+    for _ in range(5):
+        logits = model.apply(variables, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_generate_is_jittable_and_batched(lm_and_params):
+    model, variables = lm_and_params
+    prompt = jnp.asarray([[1, 2], [9, 4], [0, 0]], jnp.int32)
+    fn = jax.jit(
+        lambda v, p: generate(model, v, p, n_tokens=4, temperature=0.0)
+    )
+    out = fn(variables, prompt)
+    assert out.shape == (3, 6)
+    # Rows decode independently: row 0 alone gives the same tokens.
+    solo = generate(model, variables, prompt[:1], n_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out[:1]), np.asarray(solo))
+
+
+def test_sampling_temperature_and_top_k(lm_and_params):
+    model, variables = lm_and_params
+    prompt = jnp.asarray([[5, 5]], jnp.int32)
+    a = generate(model, variables, prompt, n_tokens=6, temperature=1.0,
+                 rng=jax.random.key(1))
+    b = generate(model, variables, prompt, n_tokens=6, temperature=1.0,
+                 rng=jax.random.key(1))
+    c = generate(model, variables, prompt, n_tokens=6, temperature=1.0,
+                 rng=jax.random.key(2))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # same key
+    assert not np.array_equal(np.asarray(a), np.asarray(c))  # diff key
+    # top_k=1 at any temperature is greedy.
+    g = generate(model, variables, prompt, n_tokens=6)
+    k1 = generate(model, variables, prompt, n_tokens=6, temperature=2.0,
+                  top_k=1, rng=jax.random.key(3))
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(k1))
+
+
+def test_budget_and_ring_guards(lm_and_params):
+    model, variables = lm_and_params
+    prompt = jnp.zeros((1, 20), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq"):
+        generate(model, variables, prompt, n_tokens=10)  # 30 > 24
+
+    ring = tiny_lm(attention="ring")
+    cache = init_kv_cache(ring, 1)
+    with pytest.raises(ValueError, match="ring"):
+        ring.apply(variables, jnp.zeros((1, 1), jnp.int32), cache=cache,
+                   pos=0)
+
+
+def test_trained_lm_generates_from_its_training_distribution(devices8):
+    """End to end: train a tiny LM on the seeded Markov stream through
+    the Trainer, then greedy-generate — generated transitions must be
+    plausible under the TRUE chain (a peaky Dirichlet makes rows
+    near-deterministic), proving trained checkpoints drive the decode
+    path."""
+    import optax
+
+    from dss_ml_at_scale_tpu.datagen.tokens import (
+        TokenStreamConfig,
+        token_batches,
+        transition_matrix,
+    )
+    from dss_ml_at_scale_tpu.parallel import LMTask, Trainer, TrainerConfig
+    from dss_ml_at_scale_tpu.runtime import make_mesh
+
+    cfg = TokenStreamConfig(vocab_size=16, batch_size=16, seq_len=24,
+                            concentration=0.02, seed=5)
+    model = tiny_lm(vocab_size=16, max_seq=24)
+    task = LMTask(model=model, tx=optax.adam(3e-3))
+    trainer = Trainer(
+        TrainerConfig(max_epochs=2, steps_per_epoch=40, log_every_steps=1000),
+        mesh=make_mesh(),
+    )
+    result = trainer.fit(task, token_batches(cfg, num_batches=80))
+    assert result.history[-1]["train_loss"] < result.history[0]["train_loss"]
+
+    variables = {"params": result.state.params}
+    first = next(token_batches(cfg, num_batches=1, sample_seed=99))
+    prompt = jnp.asarray(first["tokens"][:1, :4], jnp.int32)
+    out = np.asarray(generate(model, variables, prompt, n_tokens=12))
+
+    t = transition_matrix(cfg)
+    probs = [
+        t[int(out[0, i]), int(out[0, i + 1])]
+        for i in range(3, out.shape[1] - 1)
+    ]
+    # Greedy decode through a trained model should ride high-probability
+    # transitions of the true chain — far above the uniform 1/16.
+    assert np.mean(probs) > 0.3, (out, probs)
