@@ -1382,6 +1382,67 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+def register_runs(sub: argparse._SubParsersAction) -> None:
+    rn = sub.add_parser(
+        "runs",
+        help="browse the tracking store (the mlflow-ui equivalent for a "
+        "plain-FS root): list runs, show one run's params/metrics",
+    )
+    rsub = rn.add_subparsers(dest="runs_cmd", required=True)
+    # Same flag name, default, and env override as every writing command
+    # (_add_tracking_args), so the browser reads where the writers wrote.
+    root = os.environ.get("DSST_TRACKING_ROOT", DEFAULT_TRACKING_ROOT)
+    root_help = (
+        f"run-store root (default ./{DEFAULT_TRACKING_ROOT}, or env "
+        "DSST_TRACKING_ROOT)"
+    )
+
+    ls = rsub.add_parser("list", help="one JSON line per run, newest first")
+    ls.add_argument("--tracking-root", default=root, help=root_help)
+    ls.add_argument("--experiment", default=None)
+    ls.set_defaults(fn=_cmd_runs_list)
+
+    sh = rsub.add_parser(
+        "show", help="full record of one run (meta, params, last metrics)"
+    )
+    sh.add_argument("run", help="EXPERIMENT/RUN_ID (as `runs list` prints)")
+    sh.add_argument("--tracking-root", default=root, help=root_help)
+    sh.set_defaults(fn=_cmd_runs_show)
+
+
+def _cmd_runs_list(args: argparse.Namespace) -> int:
+    from ..tracking import list_runs
+
+    runs = list_runs(args.tracking_root, args.experiment)
+    for meta in runs:
+        print(json.dumps(meta))
+    if not runs:
+        print(f"no runs under {args.tracking_root}"
+              + (f" (experiment {args.experiment})" if args.experiment
+                 else ""),
+              file=sys.stderr)
+    return 0
+
+
+def _cmd_runs_show(args: argparse.Namespace) -> int:
+    from ..tracking import load_run
+
+    if "/" not in args.run:
+        print(f"expected EXPERIMENT/RUN_ID, got {args.run!r}")
+        return 1
+    experiment, run_id = args.run.split("/", 1)
+    try:
+        print(json.dumps(
+            load_run(args.tracking_root, experiment, run_id), indent=1
+        ))
+    except (OSError, json.JSONDecodeError):
+        # Missing run, stray file in the path, or a truncated meta.json
+        # from a killed writer — same friendly diagnosis either way.
+        print(f"no readable run {args.run} under {args.tracking_root}")
+        return 1
+    return 0
+
+
 def register_all(sub: argparse._SubParsersAction) -> None:
     register_datagen(sub)
     register_forecast(sub)
@@ -1394,6 +1455,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_lm(sub)
     register_hpo(sub)
     register_trial_worker(sub)
+    register_runs(sub)
     from .pipeline import register_pipeline
 
     register_pipeline(sub)

@@ -1,3 +1,3 @@
 """Experiment tracking: run/param/metric/artifact store."""
 
-from .store import RunStore, start_run  # noqa: F401
+from .store import RunStore, list_runs, load_run, start_run  # noqa: F401

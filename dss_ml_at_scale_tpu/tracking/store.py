@@ -143,6 +143,74 @@ class RunStore:
                 mlflow.log_metric(m["name"], m["value"], step=m["step"] or 0)
 
 
+def list_runs(root, experiment: str | None = None) -> list[dict]:
+    """Run summaries under a store root, newest first.
+
+    The read side of the store (the `mlflow ui` browsing equivalent for
+    a plain-FS root): each entry is the run's ``meta.json`` plus a
+    ``wall_seconds`` convenience — metadata only, so listing stays O(1)
+    per run regardless of metric volume (``load_run`` reads the
+    metrics). Unreadable/foreign directories are skipped, not fatal.
+    """
+    root = Path(root)
+    out: list[dict] = []
+    experiments = (
+        [root / experiment] if experiment else
+        sorted(p for p in root.iterdir() if p.is_dir()) if root.is_dir()
+        else []
+    )
+    for exp_dir in experiments:
+        if not exp_dir.is_dir():
+            continue
+        for run_dir in sorted(p for p in exp_dir.iterdir() if p.is_dir()):
+            meta_file = run_dir / "meta.json"
+            try:
+                meta = json.loads(meta_file.read_text())
+            except (OSError, json.JSONDecodeError):
+                continue
+            if meta.get("end_time") and meta.get("start_time"):
+                meta["wall_seconds"] = round(
+                    meta["end_time"] - meta["start_time"], 1
+                )
+            out.append(meta)
+    out.sort(key=lambda m: m.get("start_time", 0.0), reverse=True)
+    return out
+
+
+def load_run(root, experiment: str, run_id: str) -> dict:
+    """Full record of one run: meta, params, the last value of every
+    metric (with its step), and artifact names."""
+    path = Path(root) / experiment / run_id
+    meta = json.loads((path / "meta.json").read_text())
+    params_file = path / "params.json"
+    params = (
+        json.loads(params_file.read_text()) if params_file.exists() else {}
+    )
+    last: dict[str, dict] = {}
+    n_points = 0
+    metrics_file = path / "metrics.jsonl"
+    if metrics_file.exists():
+        with open(metrics_file, encoding="utf-8") as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                m = json.loads(line)
+                last[m["name"]] = {"value": m["value"], "step": m["step"]}
+                n_points += 1
+    artifacts_dir = path / "artifacts"
+    artifacts = (
+        sorted(p.name for p in artifacts_dir.iterdir())
+        if artifacts_dir.is_dir() else []
+    )
+    return {
+        "meta": meta,
+        "params": params,
+        "last_metrics": last,
+        "metric_points": n_points,
+        "artifacts": artifacts,
+    }
+
+
 @contextlib.contextmanager
 def start_run(root, experiment, **kwargs):
     """``with start_run(...) as run:`` — mirrors ``mlflow.start_run()``."""

@@ -37,3 +37,77 @@ def test_artifact_logging(tmp_path):
     store.log_text("hello", "notes.md")
     assert (store.path / "artifacts" / "model.txt").read_text() == "weights"
     assert (store.path / "artifacts" / "notes.md").read_text() == "hello"
+
+
+def test_list_and_load_runs(tmp_path):
+    """The store's read side: list newest-first with wall_seconds,
+    load_run returns params + last metric values; foreign junk dirs are
+    skipped."""
+    import time as _time
+
+    from dss_ml_at_scale_tpu.tracking import (
+        RunStore,
+        list_runs,
+        load_run,
+    )
+
+    a = RunStore(tmp_path, "exp1", run_name="first")
+    a.log_params({"lr": 0.1})
+    a.log_metrics({"loss": 2.0}, step=1)
+    a.log_metrics({"loss": 1.0}, step=2)
+    a.finish()
+    _time.sleep(0.01)
+    b = RunStore(tmp_path, "exp2", run_name="second")
+    b.finish("FAILED")
+    # Junk that must not break listing.
+    (tmp_path / "exp1" / "not-a-run").mkdir()
+    (tmp_path / "stray.txt").write_text("x")
+
+    runs = list_runs(tmp_path)
+    assert [r["run_name"] for r in runs] == ["second", "first"]
+    assert runs[1]["wall_seconds"] >= 0
+    assert [r["status"] for r in runs] == ["FAILED", "FINISHED"]
+    only = list_runs(tmp_path, "exp1")
+    assert len(only) == 1 and only[0]["run_id"] == a.run_id
+
+    rec = load_run(tmp_path, "exp1", a.run_id)
+    assert rec["params"] == {"lr": 0.1}
+    assert rec["last_metrics"]["loss"] == {"value": 1.0, "step": 2}
+    assert rec["metric_points"] == 2
+
+
+def test_runs_cli(tmp_path, capsys, monkeypatch):
+    import json as _json
+
+    from dss_ml_at_scale_tpu.config.cli import main
+    from dss_ml_at_scale_tpu.tracking import RunStore
+
+    r = RunStore(tmp_path, "imagenet", run_name="t")
+    r.log_metrics({"val_acc": 0.9}, step=3)
+    r.finish()
+
+    assert main(["runs", "list", "--tracking-root", str(tmp_path)]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    metas = [_json.loads(l) for l in lines]
+    assert metas[0]["run_id"] == r.run_id
+
+    assert main([
+        "runs", "show", f"imagenet/{r.run_id}", "--tracking-root", str(tmp_path),
+    ]) == 0
+    rec = _json.loads(capsys.readouterr().out)
+    assert rec["last_metrics"]["val_acc"]["value"] == 0.9
+
+    assert main([
+        "runs", "show", "imagenet/nope", "--tracking-root", str(tmp_path),
+    ]) == 1
+    capsys.readouterr()
+    assert main(["runs", "show", "malformed",
+                 "--tracking-root", str(tmp_path)]) == 1
+    capsys.readouterr()
+    # A truncated meta.json (killed writer) gets the diagnosis, not a
+    # traceback.
+    bad = tmp_path / "imagenet" / "deadbeef0000"
+    bad.mkdir()
+    (bad / "meta.json").write_text("{trunc")
+    assert main(["runs", "show", "imagenet/deadbeef0000",
+                 "--tracking-root", str(tmp_path)]) == 1
