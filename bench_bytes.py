@@ -1,4 +1,6 @@
-"""Cost-analysis byte/flop comparison: fused-BN vs flax-BN train step.
+"""Cost-analysis byte/flop comparison: fused-BN vs flax-BN train step,
+plus the second image family's roofline coordinates (vit_comparison:
+ViT-S/16 vs fused ResNet-50 flops/bytes per image).
 
 Compiles the full ResNet-50 training step both ways and records XLA's
 own cost analysis (bytes accessed, flops) — the committed, auditable
@@ -16,6 +18,16 @@ from __future__ import annotations
 
 import argparse
 import json
+
+
+def _cost_analysis(step, *args) -> dict:
+    """Lower+compile ``step`` and extract XLA's cost analysis."""
+    ca = step.lower(*args).compile().cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    return {
+        "bytes_accessed": int(ca["bytes accessed"]),
+        "flops": int(ca["flops"]),
+    }
 
 
 def measure(fused: bool, batch: int, num_classes: int = 1000):
@@ -44,14 +56,33 @@ def measure(fused: bool, batch: int, num_classes: int = 1000):
         return l, upd["batch_stats"]
 
     step = jax.jit(jax.grad(loss_fn, has_aux=True))
-    ca = step.lower(
-        variables["params"], variables["batch_stats"], x, y
-    ).compile().cost_analysis()
-    ca = ca[0] if isinstance(ca, list) else ca
-    return {
-        "bytes_accessed": int(ca["bytes accessed"]),
-        "flops": int(ca["flops"]),
-    }
+    return _cost_analysis(
+        step, variables["params"], variables["batch_stats"], x, y
+    )
+
+
+def measure_vit(batch: int, num_classes: int = 1000):
+    """Same cost analysis for the ViT-S/16 train step (models/vit.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dss_ml_at_scale_tpu.models.vit import vit_s16
+
+    model = vit_s16(num_classes)
+    x = jnp.zeros((batch, 224, 224, 3), jnp.bfloat16)
+    y = jnp.zeros((batch,), jnp.int32)
+    shapes = jax.eval_shape(lambda: model.init(jax.random.key(0), x))
+    variables = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+    )
+
+    def loss_fn(params, x, y):
+        logits = model.apply({"params": params}, x, train=True)
+        onehot = jax.nn.one_hot(y, logits.shape[-1])
+        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), -1))
+
+    step = jax.jit(jax.grad(loss_fn))
+    return _cost_analysis(step, variables["params"], x, y)
 
 
 def main() -> None:
@@ -79,12 +110,42 @@ def main() -> None:
                 "flops_ratio": round(fused["flops"] / plain["flops"], 4),
             }
         )
+
+    # Second-family roofline coordinates: ViT-S/16 vs fused ResNet-50
+    # flops/bytes per image. (Measured outcome at batch 32: arithmetic
+    # intensities are comparable — 15.3 vs 17.6 flops/byte, ViT's f32
+    # attention softmax costs bytes — and ViT-S/16 spends ~1.2x MORE
+    # flops per image (30.1 vs 24.3 GF, 2-flops-per-MAC convention);
+    # the on-chip img/s pair in bench.py's vit block is the ground
+    # truth for throughput.)
+    vb = args.batches[-1]
+    vit = measure_vit(vb)
+    r50 = rows[-1]["fused"]
+    vit_cmp = {
+        "batch": vb,
+        "vit_s16": vit,
+        "resnet50_fused": r50,
+        "flops_per_image": {
+            "vit_s16": round(vit["flops"] / vb),
+            "resnet50": round(r50["flops"] / vb),
+        },
+        "bytes_per_image": {
+            "vit_s16": round(vit["bytes_accessed"] / vb),
+            "resnet50": round(r50["bytes_accessed"] / vb),
+        },
+        "arithmetic_intensity_flops_per_byte": {
+            "vit_s16": round(vit["flops"] / vit["bytes_accessed"], 2),
+            "resnet50": round(r50["flops"] / r50["bytes_accessed"], 2),
+        },
+    }
+
     result = {
         "metric": "resnet50_train_step_bytes_fused_vs_unfused",
         "platform": "cpu-lowering (XLA cost analysis; structural ratio)",
         "model": "ResNet50 bf16 NHWC, 1000 classes, grad-of-loss train step",
         "rows": rows,
         "headline_bytes_ratio": rows[-1]["bytes_ratio"],
+        "vit_comparison": vit_cmp,
     }
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(result, f, indent=1)
