@@ -80,8 +80,9 @@ class TransformerBlock(nn.Module):
     def __call__(self, x, *, deterministic: bool = True, cache=None,
                  pos=None):
         """Full-context training/eval pass, or — with ``cache``/``pos``
-        — one KV-cached decode step (``x`` is then [b, 1, dim] and the
-        return is ``(x, new_cache)``). Both branches call the SAME
+        — a KV-cached pass returning ``(x, new_cache)``: one decode
+        step when ``x`` is [b, 1, dim], or a pos-0 prefill writing the
+        whole chunk's k/v when longer. All branches call the SAME
         submodules in the SAME order, so the parameter tree is
         identical and trained checkpoints decode without conversion."""
         if self.ffn not in ("dense", "moe"):
@@ -99,26 +100,34 @@ class TransformerBlock(nn.Module):
             return t.reshape(b, s, self.num_heads, head_dim).transpose(0, 2, 1, 3)
 
         if cache is not None:
-            # Decode step: write this token's k/v at ``pos``, attend the
-            # single query over the cache with a <= pos mask. Plain
-            # einsums — at q_len 1 there is nothing for a kernel to tile.
+            # Both cached modes write this call's k/v into the cache
+            # slab at ``pos``; they differ only in how attn is computed.
             k_cache = jax.lax.dynamic_update_slice_in_dim(
                 cache["k"], heads(k), pos, axis=2
             )
             v_cache = jax.lax.dynamic_update_slice_in_dim(
                 cache["v"], heads(v), pos, axis=2
             )
-            scores = jnp.einsum(
-                "bhqd,bhkd->bhqk", heads(q), k_cache,
-                preferred_element_type=jnp.float32,
-            ) / jnp.sqrt(head_dim).astype(jnp.float32)
-            mask = jnp.arange(k_cache.shape[2]) <= pos
-            scores = jnp.where(mask[None, None, None, :], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1)
-            attn = jnp.einsum(
-                "bhqk,bhkd->bhqd", probs, v_cache.astype(jnp.float32)
-            ).astype(self.dtype)
             new_cache = {"k": k_cache, "v": v_cache}
+            if s == 1:
+                # Decode step: attend the single query over the cache
+                # with a <= pos mask. Plain einsums — at q_len 1 there
+                # is nothing for a kernel to tile.
+                scores = jnp.einsum(
+                    "bhqd,bhkd->bhqk", heads(q), k_cache,
+                    preferred_element_type=jnp.float32,
+                ) / jnp.sqrt(head_dim).astype(jnp.float32)
+                mask = jnp.arange(k_cache.shape[2]) <= pos
+                scores = jnp.where(mask[None, None, None, :], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1)
+                attn = jnp.einsum(
+                    "bhqk,bhkd->bhqd", probs, v_cache.astype(jnp.float32)
+                ).astype(self.dtype)
+            else:
+                # Prefill (pos == 0, enforced by TransformerLM): the
+                # whole prompt in ONE causal parallel pass — the
+                # training-shaped matmuls, nothing earlier to attend to.
+                attn = self.attention_fn(heads(q), heads(k), heads(v))
         else:
             attn = self.attention_fn(heads(q), heads(k), heads(v))
             new_cache = None
@@ -179,8 +188,10 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, *, deterministic: bool = True, cache=None,
                  pos=None):
         # [b, s] int32 -> [b, s, vocab] f32 logits; with ``cache``/
-        # ``pos``: one KV-cached decode step on [b, 1] tokens, returning
-        # ``(logits[b, vocab], new_cache)`` (see ``generate``).
+        # ``pos``: a KV-cached pass returning ``(logits, new_cache)`` —
+        # one decode step on [b, 1] tokens (logits [b, vocab]) or a
+        # pos-0 prefill on the whole prompt (logits [b, s, vocab]); see
+        # ``generate``.
         b, s = tokens.shape
         if s > self.max_seq:
             raise ValueError(f"seq {s} > max_seq {self.max_seq}")
@@ -191,8 +202,19 @@ class TransformerLM(nn.Module):
                 "(ring) model should decode with attention='flash' or "
                 "'reference' on the gathered sequence"
             )
+        if decoding and s > 1 and (not isinstance(pos, int) or pos != 0):
+            # A multi-token cached pass attends only WITHIN the chunk;
+            # continuing from a non-empty cache would silently ignore
+            # the cached prefix. Prefill is pos=0 only.
+            raise ValueError(
+                "multi-token cached calls are prefill only (pos=0); "
+                "continue from a prefilled cache one token at a time"
+            )
+        # Single-token decode needs no parallel attention kernel; the
+        # multi-token cases (training pass, or PREFILL writing the
+        # prompt's k/v into the cache in one causal pass) do.
         attention_fn = (
-            None if decoding else _select_attention(
+            None if decoding and s == 1 else _select_attention(
                 self.attention, mesh=self.mesh, axis_name=self.axis_name
             )
         )
@@ -203,7 +225,7 @@ class TransformerLM(nn.Module):
             (self.max_seq, self.dim),
         )
         if decoding:
-            pos_emb = jax.lax.dynamic_slice_in_dim(pos_table, pos, 1)[None]
+            pos_emb = jax.lax.dynamic_slice_in_dim(pos_table, pos, s)[None]
         else:
             pos_emb = pos_table[None, :s]
         x = tok(tokens) + pos_emb.astype(self.dtype)
@@ -235,7 +257,9 @@ class TransformerLM(nn.Module):
             self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
         )(x)
         if decoding:
-            return logits[:, 0], tuple(new_cache)
+            # Single-step callers get the one row; prefill callers get
+            # the full [b, s, vocab] (the last row seeds sampling).
+            return (logits[:, 0] if s == 1 else logits), tuple(new_cache)
         return logits
 
 
@@ -261,14 +285,13 @@ def generate(
 ) -> jax.Array:
     """Autoregressive sampling: ``[b, p + n_tokens]`` continuations.
 
-    One ``lax.scan`` over prompt-prefill AND sampling — every step is
-    the same KV-cached decode program (static shapes, one compile),
-    feeding prompt tokens while ``t < p`` and sampled tokens after.
-    ``temperature=0`` is greedy argmax; otherwise softmax sampling at
-    the given temperature, optionally truncated to the ``top_k`` most
-    likely tokens. The training-side long-context machinery (flash/
-    ring) is for the parallel pass; decode is sequential by nature and
-    runs O(max_seq) attention per token against the cache.
+    Two phases, both static-shaped: a CHUNKED PREFILL — the whole
+    prompt through one causal parallel pass (the training-shaped
+    matmuls; flash attention applies) that also writes the prompt's
+    k/v into the cache — then one ``lax.scan`` of the single-token
+    decode step for sampling. ``temperature=0`` is greedy argmax;
+    otherwise softmax sampling at the given temperature, optionally
+    truncated to the ``top_k`` most likely tokens.
     """
     b, p = prompt.shape
     total = p + int(n_tokens)
@@ -277,37 +300,53 @@ def generate(
     if rng is None:
         rng = jax.random.key(0)
 
-    def step(carry, t):
-        cache, tok_prev, key = carry
-        tok_in = jnp.where(
-            t < p,
-            jax.lax.dynamic_index_in_dim(
-                prompt, jnp.minimum(t, p - 1), axis=1, keepdims=False
-            ),
-            tok_prev,
-        )
-        logits, cache = model.apply(
-            variables, tok_in[:, None], cache=cache, pos=t
-        )
+    def sample(logits, key):
         if temperature == 0.0:
-            nxt = jnp.argmax(logits, axis=-1)
-        else:
-            scaled = logits / temperature
-            if top_k is not None:
-                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-                scaled = jnp.where(scaled < kth, -1e30, scaled)
-            key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, scaled, axis=-1)
-        nxt = nxt.astype(jnp.int32)
-        return (cache, nxt, key), nxt
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+            scaled = jnp.where(scaled < kth, -1e30, scaled)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    if n_tokens <= 0:
+        return prompt
 
     cache = init_kv_cache(model, b)
-    (_, _, _), sampled = jax.lax.scan(
-        step, (cache, prompt[:, 0], rng), jnp.arange(total - 1)
+    try:
+        prefill_logits, cache = model.apply(
+            variables, prompt, cache=cache, pos=0
+        )
+    except ValueError:
+        # The flash kernel rejects some awkward prompt lengths (block
+        # divisibility); the reference path accepts any shape and the
+        # cache contents are identical.
+        prefill_logits, cache = model.clone(
+            attention="reference"
+        ).apply(variables, prompt, cache=cache, pos=0)
+    # Prefill returns [b, vocab] for a 1-token prompt (the decode-step
+    # shape) and [b, p, vocab] otherwise.
+    last_logits = prefill_logits if p == 1 else prefill_logits[:, -1]
+
+    def step(carry, i):
+        cache, logits, key = carry
+        key, sub = jax.random.split(key)
+        nxt = sample(logits, sub)  # the token at position p + i
+        logits, cache = model.apply(
+            variables, nxt[:, None], cache=cache, pos=p + i
+        )
+        return (cache, logits, key), nxt
+
+    # n_tokens - 1 decode steps; the final token needs no model call
+    # (its logits are already in the carry).
+    (_, final_logits, key), sampled = jax.lax.scan(
+        step, (cache, last_logits, rng), jnp.arange(n_tokens - 1)
     )
-    # sampled[t] is the prediction AFTER consuming position t; the
-    # continuation is predictions at t = p-1 .. total-2.
-    gen = jnp.swapaxes(sampled[p - 1:], 0, 1)
+    key, sub = jax.random.split(key)
+    last = sample(final_logits, sub)
+    gen = jnp.concatenate(
+        [jnp.swapaxes(sampled, 0, 1), last[:, None]], axis=1
+    )
     return jnp.concatenate([prompt, gen], axis=1)
 
 

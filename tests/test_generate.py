@@ -52,6 +52,37 @@ def test_decode_step_matches_full_forward(lm_and_params):
     )
 
 
+def test_prefill_matches_full_forward_and_feeds_decode(lm_and_params):
+    """Chunked prefill (whole prompt, one causal pass, cache written)
+    returns the same logits as the plain forward, and a decode step
+    continuing from the prefilled cache equals the stepped-from-scratch
+    path."""
+    model, variables = lm_and_params
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 7)), jnp.int32)
+
+    full = model.apply(variables, tokens)
+    cache = init_kv_cache(model, 2)
+    prefill, cache_p = model.apply(variables, tokens, cache=cache, pos=0)
+    np.testing.assert_allclose(
+        np.asarray(prefill), np.asarray(full), rtol=2e-4, atol=2e-4
+    )
+
+    # Continue one token from the prefilled cache vs from a cache built
+    # token by token: identical logits.
+    cache_s = init_kv_cache(model, 2)
+    for t in range(7):
+        _, cache_s = model.apply(
+            variables, tokens[:, t:t + 1], cache=cache_s, pos=t
+        )
+    nxt = jnp.full((2, 1), 11, jnp.int32)
+    l_p, _ = model.apply(variables, nxt, cache=cache_p, pos=7)
+    l_s, _ = model.apply(variables, nxt, cache=cache_s, pos=7)
+    np.testing.assert_allclose(
+        np.asarray(l_p), np.asarray(l_s), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_greedy_generate_matches_argmax_chain(lm_and_params):
     """temperature=0 generation equals manually chaining argmax through
     repeated FULL-context forwards — proving prefill, cache reuse, and
@@ -99,6 +130,54 @@ def test_sampling_temperature_and_top_k(lm_and_params):
     k1 = generate(model, variables, prompt, n_tokens=6, temperature=2.0,
                   top_k=1, rng=jax.random.key(3))
     np.testing.assert_array_equal(np.asarray(g), np.asarray(k1))
+
+
+def test_single_token_prompt(lm_and_params):
+    """p=1 prefill returns the decode-step logits shape; generation
+    still matches the chained-argmax ground truth."""
+    model, variables = lm_and_params
+    prompt = jnp.asarray([[9]], jnp.int32)
+    out = generate(model, variables, prompt, n_tokens=4)
+    assert out.shape == (1, 5)
+    seq = prompt
+    for _ in range(4):
+        logits = model.apply(variables, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_flash_model_generates_at_awkward_prompt_lengths():
+    """A flash-attention model must generate for prompt lengths the
+    kernel's block constraints reject — the prefill falls back to the
+    reference path (same numbers, any shape)."""
+    model = tiny_lm(attention="flash", max_seq=400)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init(jax.random.key(0), tokens)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 31, (1, 300)), jnp.int32
+    )
+    out = generate(model, variables, prompt, n_tokens=3)
+    assert out.shape == (1, 303)
+    # Ground truth via the reference model (same params).
+    ref = generate(model.clone(attention="reference"), variables, prompt,
+                   n_tokens=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_multi_token_cached_call_requires_pos_zero(lm_and_params):
+    model, variables = lm_and_params
+    cache = init_kv_cache(model, 1)
+    with pytest.raises(ValueError, match="prefill only"):
+        model.apply(variables, jnp.zeros((1, 3), jnp.int32), cache=cache,
+                    pos=2)
+
+
+def test_n_tokens_zero_returns_prompt(lm_and_params):
+    model, variables = lm_and_params
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    out = generate(model, variables, prompt, n_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
 
 
 def test_budget_and_ring_guards(lm_and_params):
