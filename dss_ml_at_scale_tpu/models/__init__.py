@@ -2,7 +2,11 @@
 
 from .resnet import ResNet, ResNet18, ResNet50, ResNet101  # noqa: F401
 from .vit import ViT, vit_t16, vit_s16  # noqa: F401
-from .metrics import cross_entropy_loss, multiclass_accuracy  # noqa: F401
+from .metrics import (  # noqa: F401
+    cross_entropy_loss,
+    multiclass_accuracy,
+    topk_accuracy,
+)
 from .transformer import (  # noqa: F401
     RMSNorm,
     TransformerLM,

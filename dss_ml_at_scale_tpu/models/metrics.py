@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 import optax
+from jax import lax
 
 
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -19,3 +20,19 @@ def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 def multiclass_accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Top-1 accuracy (the reference's torchmetrics Accuracy, num_classes=1000)."""
     return (jnp.argmax(logits, axis=-1) == labels).mean()
+
+
+def topk_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
+                  k: int) -> jnp.ndarray:
+    """Top-k accuracy (the standard ImageNet top-5 companion metric).
+
+    ``lax.top_k`` keeps the reduction inside the jitted program, so the
+    SPMD globality note in the module docstring applies unchanged.
+    """
+    if k > logits.shape[-1]:
+        raise ValueError(
+            f"top-{k} accuracy needs at least {k} classes, got "
+            f"{logits.shape[-1]} (check eval_topk)"
+        )
+    _, top = lax.top_k(logits, k)
+    return (top == labels[:, None]).any(axis=-1).mean()

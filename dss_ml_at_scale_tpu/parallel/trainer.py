@@ -37,7 +37,11 @@ from flax.core import FrozenDict
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..data.prefetch import prefetch_to_mesh
-from ..models.metrics import cross_entropy_loss, multiclass_accuracy
+from ..models.metrics import (
+    cross_entropy_loss,
+    multiclass_accuracy,
+    topk_accuracy,
+)
 from ..runtime.mesh import make_mesh
 from ..runtime.topology import local_topology
 from ..utils.profiling import StepTimer
@@ -87,6 +91,10 @@ class ClassifierTask:
     # the jitted step, keyed by state.step — see data/augment.py). None
     # disables; eval/predict are never augmented.
     augment: Any = None
+    # Extra top-k accuracies for eval (e.g. (5,) adds val_top5_acc —
+    # the standard ImageNet companion metric). Empty keeps epoch
+    # summaries unchanged.
+    eval_topk: tuple = ()
 
     @property
     def _norm_constants(self):
@@ -193,10 +201,13 @@ class ClassifierTask:
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
         logits = self.model.apply(variables, images, train=False)
-        return {
+        out = {
             "val_loss": cross_entropy_loss(logits, labels),
             "val_acc": multiclass_accuracy(logits, labels),
         }
+        for k in self.eval_topk:
+            out[f"val_top{k}_acc"] = topk_accuracy(logits, labels, k)
+        return out
 
 
 @dataclasses.dataclass

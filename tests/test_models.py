@@ -1,3 +1,4 @@
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -46,3 +47,47 @@ def test_resnet50_param_count():
     )
     n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(variables["params"]))
     assert abs(n - 25_557_032) / 25_557_032 < 0.01, n
+
+
+def test_topk_accuracy():
+    from dss_ml_at_scale_tpu.models import topk_accuracy
+
+    logits = jnp.asarray([
+        [9.0, 5.0, 1.0, 0.0],   # top-2 = {0, 1}
+        [0.0, 1.0, 5.0, 9.0],   # top-2 = {3, 2}
+        [1.0, 9.0, 5.0, 0.0],   # top-2 = {1, 2}
+    ])
+    labels = jnp.asarray([1, 0, 0])
+    # top-1: none right; top-2: rows 0 (label 1 in {0,1}); top-4: all.
+    assert float(topk_accuracy(logits, labels, 1)) == 0.0
+    assert float(topk_accuracy(logits, labels, 2)) == pytest.approx(1 / 3)
+    assert float(topk_accuracy(logits, labels, 4)) == 1.0
+    with pytest.raises(ValueError, match="at least 9 classes"):
+        topk_accuracy(logits, labels, 9)
+
+
+def test_eval_topk_in_trainer(devices8):
+    import optax
+
+    from test_trainer import synthetic_batches
+
+    from dss_ml_at_scale_tpu.parallel import (
+        ClassifierTask,
+        Trainer,
+        TrainerConfig,
+    )
+    from dss_ml_at_scale_tpu.runtime import make_mesh
+
+    task = ClassifierTask(model=tiny_resnet(num_classes=4),
+                          tx=optax.adam(1e-2), eval_topk=(2,))
+    trainer = Trainer(
+        TrainerConfig(max_epochs=1, steps_per_epoch=5, log_every_steps=1000),
+        mesh=make_mesh(),
+    )
+    result = trainer.fit(
+        task, iter(synthetic_batches(5)),
+        val_data_factory=lambda: synthetic_batches(2, seed=3),
+    )
+    h = result.history[-1]
+    assert "val_top2_acc" in h
+    assert h["val_top2_acc"] >= h["val_acc"]
