@@ -29,7 +29,6 @@ all fail and it falls back to a center crop.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 
 import jax
 import jax.numpy as jnp

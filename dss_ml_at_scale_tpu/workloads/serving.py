@@ -26,7 +26,6 @@ import base64
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from pathlib import Path
 
 
 class Predictor:
@@ -36,7 +35,6 @@ class Predictor:
                  micro_batch: int = 8):
         import numpy as np
 
-        import jax
         import jax.numpy as jnp
 
         from ..config.checkpoints import make_scorer, resolve_checkpoint
