@@ -10,10 +10,12 @@ Design points (TPU-shaped):
   micro-batch; requests are padded up to it (and chunked above it), so
   no request shape ever triggers a recompile — the latency profile is
   flat after warmup.
-- **Same decode, same normalization**: images go through the training
-  transform path (``decode_resize_crop`` + the task's normalization
-  constants), and class names come from the label vocabulary persisted
-  WITH the checkpoint — predictions match ``dsst predict`` bit for bit.
+- **Same decode, same normalization**: images go through THE training
+  transform spec (``imagenet_transform_spec`` — resize-256 field of
+  view, normalization, native decode backend) and the same jitted
+  scorer ``dsst predict`` uses (``config/checkpoints.make_scorer``);
+  class names come from the label vocabulary persisted WITH the
+  checkpoint — predictions match ``dsst predict`` by construction.
 - **Endpoints**: ``GET /healthz`` (model/step/status), ``POST /predict``
   with either a raw JPEG body (``Content-Type: image/jpeg``) or JSON
   ``{"instances": ["<base64 jpeg>", ...]}`` → JSON
