@@ -402,6 +402,11 @@ def register_train(sub: argparse._SubParsersAction) -> None:
         "--no-fused-bn falls back to flax BatchNorm",
     )
     tr.add_argument(
+        "--eval-topk", type=int, nargs="*", default=[],
+        help="extra top-k val accuracies (e.g. --eval-topk 5 adds "
+        "val_top5_acc, the standard ImageNet companion metric)",
+    )
+    tr.add_argument(
         "--augment", action="store_true",
         help="on-device train-time RandomResizedCrop + horizontal flip "
         "inside the jitted step (data/augment.py): the reference's "
@@ -527,12 +532,20 @@ def _cmd_train(args: argparse.Namespace) -> int:
         args.model, num_classes=args.num_classes, torch_padding=torch_padding,
         fused_bn=args.fused_bn,
     )
+    for k in args.eval_topk:
+        # Fail BEFORE training, not at the first eval a whole epoch in.
+        if not 1 <= k <= args.num_classes:
+            raise SystemExit(
+                f"--eval-topk {k} must be in [1, num_classes="
+                f"{args.num_classes}]"
+            )
     augment = None
     if args.augment:
         from ..data.augment import AugmentConfig
 
         augment = AugmentConfig()
-    task = ClassifierTask(model=model, tx=optax.adam(lr), augment=augment)
+    task = ClassifierTask(model=model, tx=optax.adam(lr), augment=augment,
+                          eval_topk=tuple(args.eval_topk))
 
     init_state = None
     if args.pretrained and not _has_checkpoint(args):
@@ -602,6 +615,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 "images_per_sec": round(last.get("images_per_sec", 0.0), 2),
                 "train_loss": last.get("train_loss"),
                 "val_acc": last.get("val_acc"),
+                # --eval-topk metrics surface in the summary too.
+                **{f"val_top{k}_acc": last.get(f"val_top{k}_acc")
+                   for k in args.eval_topk},
                 "best_checkpoint": result.best_checkpoint_path,
                 "decode_backend": spec.backend,
                 "decode_substitutions": spec.substitutions.count,

@@ -750,6 +750,42 @@ def test_resolve_lr_schedule_precedence():
     assert meta5 == {"lr_schedule": "constant"}
 
 
+def test_train_cli_eval_topk(tmp_path, capsys, devices8):
+    """--eval-topk 2 lands val_top2_acc in the training summary's
+    underlying history (surface check via a val split)."""
+    from test_end_to_end import _jpeg
+    import pyarrow as pa
+
+    from dss_ml_at_scale_tpu.data import write_delta
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 64)
+    table = pa.table({
+        "content": pa.array([_jpeg(rng, l) for l in labels], type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    data = tmp_path / "images"
+    write_delta(table, data, max_rows_per_file=16)
+    assert main([
+        "train", "--data", str(data), "--val-data", str(data),
+        "--model", "tiny",
+        "--num-classes", "4", "--crop", "64", "--batch-size", "16",
+        "--epochs", "1", "--eval-topk", "2",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["val_top2_acc"] is not None
+    assert summary["val_top2_acc"] >= summary["val_acc"]
+    # Invalid k fails before any training runs.
+    with pytest.raises(SystemExit, match="eval-topk"):
+        main([
+            "train", "--data", str(data), "--model", "tiny",
+            "--num-classes", "4", "--crop", "64", "--batch-size", "16",
+            "--epochs", "1", "--eval-topk", "9",
+            "--checkpoint-dir", str(tmp_path / "ckpt2"),
+        ])
+
+
 def test_lm_cli_sample(capsys, devices8, tmp_path, monkeypatch):
     """dsst lm --sample N: trained-model greedy generation scored
     against the true chain lands in the summary."""
