@@ -566,7 +566,7 @@ def test_datagen_photos_and_ingest_label_index(tmp_path, capsys):
     capsys.readouterr()
 
 
-def _run_pipeline_spec(spec: str, tmp_path) -> str:
+def _run_pipeline_spec(spec: str, tmp_path, timeout: float = 900) -> str:
     """Run a shipped pipeline spec as a real subprocess DAG on the
     simulated CPU slice (tasks must not claim a possibly-hung accelerator
     tunnel in CI); returns stdout after asserting success + predictions."""
@@ -580,7 +580,7 @@ def _run_pipeline_spec(spec: str, tmp_path) -> str:
         env={**env,
              "XLA_FLAGS": (env.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")},
-        capture_output=True, text=True, timeout=900,
+        capture_output=True, text=True, timeout=timeout,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert rc.returncode == 0, rc.stdout[-2000:] + rc.stderr[-2000:]
@@ -801,3 +801,20 @@ def test_lm_cli_sample(capsys, devices8, tmp_path, monkeypatch):
     assert len(summary["sample_tokens"]) == 12  # 4 prompt + 8 generated
     assert 0.0 <= summary["sample_mean_true_prob"] <= 1.0
     assert summary["sample_chance_prob"] == round(1 / 16, 4)
+
+
+@pytest.mark.slow
+def test_full_stack_pipeline_spec(tmp_path):
+    """The showcase DAG: all three tracks in one run — demand ->
+    forecast, images -> train(+top-k) -> predict + export, lm train +
+    sample — as real subprocesses."""
+    # 7 serial tasks; give the harness budget room above the spec's own
+    # per-task ceilings on a loaded CI host.
+    out = _run_pipeline_spec("pipelines/full_stack.json", tmp_path,
+                             timeout=2400)
+    assert (tmp_path / "forecasts" / "_delta_log").is_dir()
+    assert (tmp_path / "weights.npz").exists()
+    lm_line = [l for l in out.splitlines() if "sample_mean_true_prob" in l][-1]
+    assert json.loads(lm_line)["sample_mean_true_prob"] >= 0.0
+    train_line = [l for l in out.splitlines() if "val_top2_acc" in l][-1]
+    assert json.loads(train_line)["val_top2_acc"] is not None
