@@ -169,3 +169,51 @@ def test_serving_matches_dsst_predict(server, trained_ckpt, tmp_path):
         assert status == 200
         served = payload["predictions"][0]
         assert served["pred_index"] == int(table_preds["pred_index"].iloc[i])
+
+
+@pytest.mark.slow
+def test_serving_vit_checkpoint(tmp_path, devices8):
+    """The server resolves and serves a ViT checkpoint too (stat-free
+    restore through the shared resolver)."""
+    import pyarrow as pa
+
+    from test_end_to_end import _jpeg
+
+    from dss_ml_at_scale_tpu.config.cli import main
+    from dss_ml_at_scale_tpu.data import write_delta
+    from dss_ml_at_scale_tpu.workloads.serving import (
+        Predictor,
+        serve_in_thread,
+    )
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 32)
+    jpegs = [_jpeg(rng, l) for l in labels]
+    table = pa.table({
+        "content": pa.array(jpegs, type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    data = tmp_path / "images"
+    write_delta(table, data, max_rows_per_file=16)
+    ckpt = tmp_path / "ckpt"
+    assert main([
+        "train", "--data", str(data), "--model", "vit-tiny",
+        "--num-classes", "4", "--crop", "64", "--batch-size", "16",
+        "--epochs", "1", "--checkpoint-dir", str(ckpt),
+    ]) == 0
+
+    predictor = Predictor(str(ckpt), micro_batch=4)
+    srv, _t = serve_in_thread(predictor)
+    try:
+        port = srv.server_address[1]
+        status, payload = _request(
+            port, "POST", "/predict", body=jpegs[0],
+            content_type="image/jpeg",
+        )
+        assert status == 200
+        assert 0 <= payload["predictions"][0]["pred_index"] < 4
+        status, health = _request(port, "GET", "/healthz")
+        assert health["model"] == "vit-tiny"
+    finally:
+        srv.shutdown()
+        srv.server_close()
