@@ -5,8 +5,16 @@
 # smoke -> staged diag last (its bulk transfers are the likeliest to
 # stall, and a stall then costs nothing downstream).
 cd "$(dirname "$0")"
+# No new probes/chains after this UTC hour:minute — the round driver
+# runs its own one-shot bench at round end, and a watchdog chain firing
+# then would contend for the single device lease.
+DEADLINE="${DSST_WATCHDOG_DEADLINE:-14:15}"
 N=0
 while true; do
+  if [ "$(date -u +%H:%M)" \> "$DEADLINE" ]; then
+    echo "$(date -u +%H:%M:%S) deadline $DEADLINE reached - watchdog exiting" >> tpu_watchdog.log
+    break
+  fi
   N=$((N + 1))
   # Quick probes catch a healthy tunnel; every 4th probe is patient
   # (30 min): the one observed definitive resolution of a half-up claim
