@@ -115,7 +115,9 @@ def _salvage(path: str, key: str):
         return None
     if partial.get("platform", "cpu") == "cpu":
         return None
-    return partial if partial.get(key) else None
+    # `is not None` (not truthiness): a legitimately-zero measurement is
+    # still a salvageable on-accelerator record.
+    return partial if partial.get(key) is not None else None
 
 
 # ---------------------------------------------------------------------------
@@ -646,11 +648,13 @@ def _append_note(result: dict, msg: str) -> None:
 
 
 def child_train() -> None:
+    # "value" is deliberately ABSENT until the first real measurement:
+    # the parent's _salvage treats a present value (even 0.0) as a
+    # measurement, so a pre-measurement checkpoint (e.g. the tunnel
+    # block) must not carry a placeholder.
     result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
-        "value": 0.0,
         "unit": "images/sec",
-        "vs_baseline": 0.0,
     }
     try:
         import jax
@@ -673,9 +677,10 @@ def child_train() -> None:
         if partial and partial.get("platform") == platform:
             # ("note" deliberately not copied: a stale truncation note
             # would mislabel a resumed sweep that then completed.)
-            for k in ("sweep", "unfused", "profile", "pipeline",
-                      "peak_device_memory_bytes_sweep", "value", "unit",
-                      "vs_baseline", "tunnel"):
+            for k in ("sweep", "unfused", "unfused_headline", "pallas",
+                      "pallas_headline", "profile", "pipeline",
+                      "peak_device_memory_bytes_sweep", "value",
+                      "unit", "vs_baseline", "tunnel"):
                 v = partial.get(k)
                 if v is None:
                     continue
@@ -702,13 +707,33 @@ def child_train() -> None:
 
         from dss_ml_at_scale_tpu.utils.benchlib import build_resnet_task
 
-        # Reference per-rank batch is 212 (deep_learning/2...py:342); the
-        # sweep adds larger TPU-shaped candidates (bf16 ResNet-50 fits
-        # them all on a v5e chip).
-        # 212 is the reference's per-rank batch (2...py:342); larger
-        # TPU-shaped candidates follow. 768 probes the HBM ceiling — an
-        # OOM there is caught as a sweep point, not a failure.
-        batches = [212, 256, 384, 512, 768] if on_accel else [8]
+        # HEADLINE-FIRST ordering: with the tunnel's observed pattern of
+        # brief live windows, the first ~2 minutes of chip time must
+        # produce the one number that matters.  The expected-winning
+        # batch (384; override via DSST_BENCH_HEADLINE_BATCH) is
+        # measured FIRST and checkpointed, the fused/unfused pair runs
+        # immediately after it (see the in-loop pair block), and only
+        # then do the remaining candidates run — the reference's 212
+        # per-rank batch (deep_learning/2...py:342) plus larger
+        # TPU-shaped points; 768 probes the HBM ceiling (an OOM there is
+        # caught as a sweep point, not a failure).
+        try:
+            headline_bs = int(
+                os.environ.get("DSST_BENCH_HEADLINE_BATCH", "384")
+            )
+        except ValueError:
+            # A typo'd tuning knob must not zero the headline (the env
+            # var reaches every child, so raising here would fail the
+            # accelerator attempts AND the CPU fallback identically).
+            headline_bs = 384
+            _append_note(
+                result, "bad DSST_BENCH_HEADLINE_BATCH ignored; using 384"
+            )
+        batches = (
+            [headline_bs] + [b for b in (212, 256, 384, 512, 768)
+                             if b != headline_bs]
+            if on_accel else [8]
+        )
         image = 224 if on_accel else 64
         steps = 10 if on_accel else 2
         peak_flops = PEAK_BF16_FLOPS.get(device_kind)
@@ -725,6 +750,7 @@ def child_train() -> None:
             if best is None or p["images_per_sec"] > best[0]:
                 best = (p["images_per_sec"], p["batch"], None)
         t_start = time.perf_counter()
+        pair_cache = None  # (batch, step, task, ips) from the in-loop pair
         for bs in batches:
             if bs in done_batches:
                 continue
@@ -765,20 +791,75 @@ def child_train() -> None:
                 vs_baseline=round(best[0] / A100_IMG_PER_SEC, 4),
             )
             _save_partial(result)
+            # Fused/unfused pair IMMEDIATELY after the first successful
+            # point (normally the headline batch): the measured byte-cut
+            # ratio must exist within minutes of a live window, not only
+            # if the whole sweep survives it.
+            if on_accel and "unfused" not in result:
+                try:
+                    pair_task = build_resnet_task(
+                        num_classes=1000, on_accel=on_accel, fused_bn=False
+                    )
+                    _pair_step, pair_ips, _ = _bench_compute_at(
+                        jax, pair_task, bs, image, steps
+                    )
+                    result["unfused"] = {
+                        "batch": bs,
+                        "images_per_sec": round(pair_ips, 2),
+                        "fused_speedup": round(ips / pair_ips, 4),
+                    }
+                    # Deliberately NOT caching the unfused executable:
+                    # holding it through the remaining (larger) sweep
+                    # points could shift the intentional HBM-ceiling
+                    # probe at batch 768.  The rare swap path below
+                    # rebuilds it via the compile cache instead.
+                    del _pair_step, pair_task
+                except Exception as e:
+                    result["unfused"] = {
+                        "error": f"{type(e).__name__}: {e}"[:200]
+                    }
+                _save_partial(result)
+            # Second lever immediately after the first: the Pallas
+            # prologue-fused model (ops/fused_matmul.py) at the same
+            # batch.  Measured before the rest of the sweep for the
+            # same reason the pair is; swap insurance stays post-sweep.
+            if (on_accel and "pallas" not in result
+                    and not os.environ.get("DSST_BENCH_NO_PALLAS")):
+                try:
+                    pl_task = build_resnet_task(
+                        num_classes=1000, on_accel=on_accel,
+                        fused_bn="pallas",
+                    )
+                    _pl_step, pl_ips, _ = _bench_compute_at(
+                        jax, pl_task, bs, image, steps
+                    )
+                    result["pallas"] = {
+                        "batch": bs,
+                        "images_per_sec": round(pl_ips, 2),
+                        "speedup_vs_fused": round(pl_ips / ips, 4),
+                    }
+                    del _pl_step, pl_task  # same HBM discipline as pair
+                except Exception as e:
+                    result["pallas"] = {
+                        "error": f"{type(e).__name__}: {e}"[:200]
+                    }
+                _save_partial(result)
         if best is None:
             raise RuntimeError(f"every sweep point failed: {sweep}")
         # A prior (killed) attempt may already have swapped the headline
-        # to the unfused program — its sweep point carries bn=unfused.
+        # to the unfused or pallas program — its sweep point carries bn=.
         unfused_headline = any(p.get("bn") == "unfused" for p in sweep)
+        pallas_headline = any(p.get("bn") == "pallas" for p in sweep)
         ips, best_batch, train_step = best
         result["sweep"] = sweep
+        bn_tag = (", unfused BN)" if unfused_headline
+                  else ", pallas-fused)" if pallas_headline else ")")
         result.update(
             value=round(ips, 2),
-            unit=f"images/sec (batch {best_batch}, {device_kind}"
-            + (", unfused BN)" if unfused_headline else ")"),
+            unit=f"images/sec (batch {best_batch}, {device_kind}{bn_tag}",
             vs_baseline=round(ips / A100_IMG_PER_SEC, 4),
         )
-        if train_step is None and not unfused_headline:
+        if train_step is None and not (unfused_headline or pallas_headline):
             # Resumed past the winning point: rebuild its executable
             # (persistent compile cache makes this cheap) for the
             # profile / pipeline sections below.
@@ -789,10 +870,12 @@ def child_train() -> None:
         import tempfile
 
         # Peak across the WHOLE sweep — including any failed/OOM'd batch
-        # attempts — hence the explicit _sweep suffix; it bounds HBM for
-        # the largest configuration tried, not the best batch alone.
-        # Captured BEFORE the unfused comparison run so that model's
-        # (larger) footprint cannot contaminate the fused sweep's bound.
+        # attempts AND the in-loop fused/unfused pair at the headline
+        # batch — hence the explicit _sweep suffix; it is the process's
+        # HBM high-water mark for everything tried so far, not a
+        # fused-model-only bound (the headline-first pair run made a
+        # pure-fused bound impossible to capture; the honest label
+        # changed with it).
         if "peak_device_memory_bytes_sweep" not in result:
             peak = _peak_device_memory(jax)
             if peak is not None:
@@ -800,33 +883,56 @@ def child_train() -> None:
         _save_partial(result)
 
         # A resumed attempt whose earlier run already swapped the
-        # headline to the unfused program must rebuild THAT executable
-        # for the profile / pipeline sections.
-        if on_accel and unfused_headline:
-            unfused_task = build_resnet_task(
-                num_classes=1000, on_accel=on_accel, fused_bn=False
+        # headline to the unfused/pallas program must rebuild THAT
+        # executable for the profile / pipeline sections.
+        if on_accel and (unfused_headline or pallas_headline):
+            swapped_task = build_resnet_task(
+                num_classes=1000, on_accel=on_accel,
+                fused_bn=False if unfused_headline else "pallas",
             )
             train_step, _ips_re, _ = _bench_compute_at(
-                jax, unfused_task, best_batch, image, steps
+                jax, swapped_task, best_batch, image, steps
             )
-            task = unfused_task
+            task = swapped_task
 
-        # The sweep runs the fused-BN model (the default); one unfused
-        # point at the winning batch documents the fused-VJP byte cut as
-        # a measured on-chip speedup, not just a cost-analysis claim.
-        if on_accel and "unfused" not in result:
-            try:
-                unfused_task = build_resnet_task(
-                    num_classes=1000, on_accel=on_accel, fused_bn=False
-                )
-                unfused_step, unfused_ips, _ = _bench_compute_at(
-                    jax, unfused_task, best_batch, image, steps
-                )
-                result["unfused"] = {
-                    "batch": best_batch,
-                    "images_per_sec": round(unfused_ips, 2),
-                    "fused_speedup": round(ips / unfused_ips, 4),
-                }
+        # The sweep runs the fused-BN model (the default); the unfused
+        # comparison documents the fused-VJP byte cut as a measured
+        # on-chip speedup, not just a cost-analysis claim.  The pair
+        # normally already ran in-loop at the headline batch; it is
+        # (re)measured here only if missing, or if a DIFFERENT batch won
+        # the sweep — so the swap-insurance below always compares fused
+        # vs unfused at the winning batch.
+        if on_accel:
+            pair = result.get("unfused")
+            pair_ok = isinstance(pair, dict) and "images_per_sec" in pair
+            if pair_ok and pair.get("batch") != best_batch:
+                # Keep the early (headline-batch) pair as evidence; the
+                # winning-batch pair replaces it as the canonical one.
+                result["unfused_headline"] = pair
+                pair_ok = False
+            if not pair_ok:
+                try:
+                    unfused_task = build_resnet_task(
+                        num_classes=1000, on_accel=on_accel, fused_bn=False
+                    )
+                    unfused_step, unfused_ips, _ = _bench_compute_at(
+                        jax, unfused_task, best_batch, image, steps
+                    )
+                    result["unfused"] = {
+                        "batch": best_batch,
+                        "images_per_sec": round(unfused_ips, 2),
+                        "fused_speedup": round(ips / unfused_ips, 4),
+                    }
+                    pair_cache = (best_batch, unfused_step, unfused_task,
+                                  unfused_ips)
+                    pair_ok = True
+                except Exception as e:
+                    result["unfused"] = {
+                        "error": f"{type(e).__name__}: {e}"[:200]
+                    }
+                _save_partial(result)
+            if pair_ok:
+                unfused_ips = result["unfused"]["images_per_sec"]
                 if unfused_ips > ips:
                     # Insurance for the driver's one shot: if the fused
                     # path ever regresses on real hardware, the headline
@@ -835,6 +941,18 @@ def child_train() -> None:
                     # result. The downstream profile/pipeline sections
                     # follow the swap so every block of the artifact
                     # describes the SAME (headline) program.
+                    if pair_cache is not None and pair_cache[0] == best_batch:
+                        _, unfused_step, unfused_task, _ = pair_cache
+                    else:
+                        # Resumed attempt: rebuild the unfused executable
+                        # (persistent compile cache makes this cheap).
+                        unfused_task = build_resnet_task(
+                            num_classes=1000, on_accel=on_accel,
+                            fused_bn=False
+                        )
+                        unfused_step, _ips_re, _ = _bench_compute_at(
+                            jax, unfused_task, best_batch, image, steps
+                        )
                     train_step, task, ips = unfused_step, unfused_task, unfused_ips
                     for point in sweep:
                         # The sweep feeds scaling_model.py's step-time
@@ -857,11 +975,73 @@ def child_train() -> None:
                         "winning batch; headline, profile, and pipeline all "
                         "use the unfused program",
                     )
-            except Exception as e:
-                result["unfused"] = {
-                    "error": f"{type(e).__name__}: {e}"[:200]
-                }
-            _save_partial(result)
+                    _save_partial(result)
+
+        # Second-lever swap: if the Pallas prologue-fused program is the
+        # fastest at the winning batch, it becomes the headline (and the
+        # profile/pipeline program).  Re-measured at best_batch if the
+        # in-loop point ran at a different one.
+        if on_accel and not os.environ.get("DSST_BENCH_NO_PALLAS"):
+            pall = result.get("pallas")
+            pall_ok = isinstance(pall, dict) and "images_per_sec" in pall
+            if pall_ok and pall.get("batch") != best_batch:
+                result["pallas_headline"] = pall
+                pall_ok = False
+            if not pall_ok and not (isinstance(pall, dict)
+                                    and "error" in pall):
+                try:
+                    pl_task = build_resnet_task(
+                        num_classes=1000, on_accel=on_accel,
+                        fused_bn="pallas",
+                    )
+                    _pl_step, pl_ips, _ = _bench_compute_at(
+                        jax, pl_task, best_batch, image, steps
+                    )
+                    result["pallas"] = {
+                        "batch": best_batch,
+                        "images_per_sec": round(pl_ips, 2),
+                        "speedup_vs_fused": round(pl_ips / ips, 4),
+                    }
+                    del _pl_step, pl_task
+                    pall_ok = True
+                except Exception as e:
+                    result["pallas"] = {
+                        "error": f"{type(e).__name__}: {e}"[:200]
+                    }
+                _save_partial(result)
+            if pall_ok:
+                pl_ips = result["pallas"]["images_per_sec"]
+                if pl_ips > ips:
+                    pl_task = build_resnet_task(
+                        num_classes=1000, on_accel=on_accel,
+                        fused_bn="pallas",
+                    )
+                    pl_step, _ips_re, _ = _bench_compute_at(
+                        jax, pl_task, best_batch, image, steps
+                    )
+                    train_step, task, ips = pl_step, pl_task, pl_ips
+                    for point in sweep:
+                        if (point.get("batch") == best_batch
+                                and "images_per_sec" in point):
+                            point.setdefault(
+                                "images_per_sec_fused",
+                                point["images_per_sec"],
+                            )
+                            point["images_per_sec"] = round(pl_ips, 2)
+                            point["bn"] = "pallas"
+                    result.update(
+                        value=round(pl_ips, 2),
+                        unit=f"images/sec (batch {best_batch}, "
+                        f"{device_kind}, pallas-fused)",
+                        vs_baseline=round(pl_ips / A100_IMG_PER_SEC, 4),
+                    )
+                    _append_note(
+                        result,
+                        "pallas prologue-fused program fastest at the "
+                        "winning batch; headline, profile, and pipeline "
+                        "all use it",
+                    )
+                    _save_partial(result)
 
         with tempfile.TemporaryDirectory() as tmpdir:
             # -- profiler: top device-time categories -----------------------
@@ -893,6 +1073,8 @@ def child_train() -> None:
     except Exception:
         _append_note(result, traceback.format_exc(limit=5))
         result["failed"] = True  # tells the parent to retry / fall back
+    result.setdefault("value", 0.0)
+    result.setdefault("vs_baseline", 0.0)
     print(json.dumps(result))
 
 

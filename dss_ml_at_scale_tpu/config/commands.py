@@ -693,6 +693,16 @@ def _checkpoint_task(checkpoint_dir, crop_override=None):
     except FileNotFoundError as e:
         print(e)
         return None
+    except (json.JSONDecodeError, KeyError) as e:
+        # Corrupt dsst_model.json (truncated write, foreign file) or one
+        # missing a required key: same was-this-written-by-dsst-train
+        # diagnosis as a missing meta file, not a raw traceback.
+        print(
+            f"unreadable model metadata in {checkpoint_dir}/dsst_model.json"
+            f" ({type(e).__name__}: {e}) — was this checkpoint written by"
+            " `dsst train`?"
+        )
+        return None
     except ValueError as e:
         raise SystemExit(str(e))
 
@@ -1373,12 +1383,19 @@ def register_serve(sub: argparse._SubParsersAction) -> None:
 def _cmd_serve(args: argparse.Namespace) -> int:
     from ..workloads.serving import Predictor, make_server
 
+    # Resolve the metadata FIRST (narrowly scoped corrupt-meta
+    # diagnosis, same as predict/export); a KeyError from the much
+    # larger Predictor construction below — e.g. an orbax tree that
+    # doesn't match the model — must NOT be misattributed to
+    # dsst_model.json.
+    if _checkpoint_task(args.checkpoint_dir) is None:
+        return 1
     try:
         predictor = Predictor(args.checkpoint_dir, step=args.step,
                               micro_batch=args.micro_batch)
     except FileNotFoundError as e:
-        # Missing dsst_model.json OR missing orbax steps: print the
-        # diagnosis and exit like predict/export, no traceback.
+        # Missing orbax steps: print the diagnosis and exit like
+        # predict/export, no traceback.
         print(e)
         return 1
     server = make_server(predictor, args.host, args.port)
@@ -1451,9 +1468,10 @@ def _cmd_runs_show(args: argparse.Namespace) -> int:
         print(json.dumps(
             load_run(args.tracking_root, experiment, run_id), indent=1
         ))
-    except (OSError, json.JSONDecodeError):
-        # Missing run, stray file in the path, or a truncated meta.json
-        # from a killed writer — same friendly diagnosis either way.
+    except (OSError, json.JSONDecodeError, KeyError):
+        # Missing run, stray file in the path, a truncated meta.json
+        # from a killed writer, or a metrics line missing name/value/step
+        # (foreign writer) — same friendly diagnosis either way.
         print(f"no readable run {args.run} under {args.tracking_root}")
         return 1
     return 0

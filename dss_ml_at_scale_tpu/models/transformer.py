@@ -320,7 +320,13 @@ def generate(
     except ValueError:
         # The flash kernel rejects some awkward prompt lengths (block
         # divisibility); the reference path accepts any shape and the
-        # cache contents are identical.
+        # cache contents are identical.  Only the flash model gets this
+        # fallback: for any other attention mode a ValueError is a real
+        # configuration error (e.g. a ring model whose decode step
+        # cannot run here anyway) and must stay loud rather than be
+        # masked by a retry that would fail later in the scan.
+        if getattr(model, "attention", None) != "flash":
+            raise
         prefill_logits, cache = model.clone(
             attention="reference"
         ).apply(variables, prompt, cache=cache, pos=0)

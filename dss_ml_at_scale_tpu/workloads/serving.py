@@ -112,8 +112,16 @@ class Predictor:
 
 
 def make_server(predictor: Predictor, host: str = "127.0.0.1",
-                port: int = 8008) -> ThreadingHTTPServer:
-    """A ready-to-run server (caller picks ``serve_forever`` vs thread)."""
+                port: int = 8008, *,
+                max_body_bytes: int = 64 * 1024 * 1024,
+                max_instances: int = 1024) -> ThreadingHTTPServer:
+    """A ready-to-run server (caller picks ``serve_forever`` vs thread).
+
+    ``max_body_bytes`` / ``max_instances`` bound what one request can
+    make the server materialize (413 above the caps): without them a
+    single oversized POST would be read and base64-decoded wholesale
+    into memory (low-risk at the 127.0.0.1 default bind, but the caps
+    make the exposure explicit and configurable)."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet by default; errors still raise
@@ -142,16 +150,39 @@ def make_server(predictor: Predictor, host: str = "127.0.0.1",
             if self.path != "/predict":
                 self._json(404, {"error": f"no route {self.path}"})
                 return
-            length = int(self.headers.get("Content-Length", 0))
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                self._json(400, {"error": "bad Content-Length"})
+                return
+            if length < 0:
+                # A negative length would make rfile.read() read until
+                # EOF — exactly the unbounded read the cap exists to
+                # prevent.
+                self._json(400, {"error": "bad Content-Length"})
+                return
+            if length > max_body_bytes:
+                self._json(413, {
+                    "error": f"body {length} bytes exceeds limit "
+                             f"{max_body_bytes}",
+                })
+                return
             body = self.rfile.read(length)
             try:
                 if self.headers.get("Content-Type", "").startswith(
                     "application/json"
                 ):
                     payload = json.loads(body)
-                    jpegs = [
-                        base64.b64decode(x) for x in payload["instances"]
-                    ]
+                    instances = payload["instances"]
+                    if (not isinstance(instances, list)
+                            or len(instances) > max_instances):
+                        self._json(413 if isinstance(instances, list)
+                                   else 400, {
+                            "error": "instances must be a list of at "
+                                     f"most {max_instances} items",
+                        })
+                        return
+                    jpegs = [base64.b64decode(x) for x in instances]
                 else:
                     jpegs = [body]  # raw single JPEG
                 if not jpegs:

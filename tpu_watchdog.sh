@@ -8,10 +8,15 @@ cd "$(dirname "$0")"
 # No new probes/chains after this UTC hour:minute — the round driver
 # runs its own one-shot bench at round end, and a watchdog chain firing
 # then would contend for the single device lease.
-DEADLINE="${DSST_WATCHDOG_DEADLINE:-14:15}"
+# Round 5 started ~15:40 UTC Jul 31 with a ~12 h budget; leave the last
+# ~45 min uncontended for the driver's round-end bench.
+DEADLINE="${DSST_WATCHDOG_DEADLINE:-02:45}"
+START_DAY="$(date -u +%d)"
 N=0
 while true; do
-  if [ "$(date -u +%H:%M)" \> "$DEADLINE" ]; then
+  # Deadline is past-midnight relative to the round start: active only
+  # once the UTC day has rolled over.
+  if [ "$(date -u +%d)" != "$START_DAY" ] && [ "$(date -u +%H:%M)" \> "$DEADLINE" ]; then
     echo "$(date -u +%H:%M:%S) deadline $DEADLINE reached - watchdog exiting" >> tpu_watchdog.log
     break
   fi
@@ -35,11 +40,11 @@ PY
     sleep 10
     DSST_BENCH_TIMEOUT=2400 DSST_BENCH_GROUP_TIMEOUT=1500 DSST_BENCH_LM_TIMEOUT=1200 \
       DSST_BENCH_VIT=1 \
-      timeout 14400 python bench.py > BENCH_onchip_r4.json 2> bench_onchip_stderr.log
+      timeout 14400 python bench.py > BENCH_onchip_r5.json 2> bench_onchip_stderr.log
     echo "$(date -u +%H:%M:%S) bench rc=$?" >> tpu_watchdog.log
-    timeout 2400 python bench_accuracy.py --out ACCURACY_onchip_r4.json >> tpu_watchdog.log 2>&1
+    timeout 2400 python bench_accuracy.py --label-noise 0 --out ACCURACY_onchip_r5.json >> tpu_watchdog.log 2>&1
     echo "$(date -u +%H:%M:%S) accuracy rc=$?" >> tpu_watchdog.log
-    timeout 900 python scaling_model.py --bench-json BENCH_onchip_r4.json >> tpu_watchdog.log 2>&1
+    timeout 900 python scaling_model.py --bench-json BENCH_onchip_r5.json >> tpu_watchdog.log 2>&1
     echo "$(date -u +%H:%M:%S) scaling rc=$?" >> tpu_watchdog.log
     timeout 600 python smoke_two_device_trials.py >> tpu_watchdog.log 2>&1
     echo "$(date -u +%H:%M:%S) 2dev smoke rc=$?" >> tpu_watchdog.log
