@@ -85,6 +85,52 @@ def measure_vit(batch: int, num_classes: int = 1000):
     return _cost_analysis(step, variables["params"], x, y)
 
 
+def pallas_structural(image: int = 224) -> dict:
+    """Structural HBM-trip model for the SECOND lever: the Pallas
+    BN-apply + 1x1-conv prologue fusion (ops/fused_matmul.py).
+
+    CPU cost analysis cannot price this one (interpret-mode Pallas
+    lowers to per-grid-step HLO, and the CPU backend cannot compile the
+    real kernels), so the committed number is the backend-independent
+    activation-trip count at the fused site — the same saved-residual
+    arithmetic that underlies the fused-BN row, counted explicitly:
+
+    Per bottleneck block, at the middle-BN -> conv3 site (S spatial
+    positions, w mid-channels, 2-byte activations), versus the
+    HLO-fused baseline:
+
+      forward:  baseline  y2 r2 (stats+apply), a2 w1, a2 r1 (conv3)
+                fused     y2 r2 (stats+prologue)      -> saves 2 trips
+      backward: baseline  da2 w1 r2, a2 r1 (dW), y2 r2, dy2 w1 = 7
+                fused     gt  w1 r1, y2 r3 (da/finish/dW), dy2 w1 = 6
+                                                      -> saves 1 trip
+      net: 3 * S * w * 2 bytes per image.
+
+    The block-output BN site is NOT fusable the same way: the residual
+    shortcut gives that activation a second consumer, so materialize-
+    once-read-twice is already optimal there (counted; not a TODO).
+
+    The decisive number is bench.py's on-chip ``pallas`` point; this
+    row records why the cut exists and how large it should be.
+    """
+    stage_sizes = [3, 4, 6, 3]
+    saved = 0
+    spatial = image // 4  # after stem conv s2 + maxpool s2
+    for i, blocks in enumerate(stage_sizes):
+        if i > 0:
+            spatial //= 2
+        w = 64 * 2 ** i
+        saved += blocks * 3 * (spatial * spatial) * w * 2
+    return {
+        "method": "structural HBM activation-trip count (see docstring)",
+        "site": "middle-BN apply fused into conv3 (1x1) as Pallas "
+                "prologue",
+        "saved_bytes_per_image": saved,
+        "saved_mb_per_image": round(saved / 2**20, 2),
+        "note": "decisive measurement = bench.py 'pallas' point on chip",
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", type=int, nargs="+", default=[8, 32])
@@ -145,6 +191,7 @@ def main() -> None:
         "model": "ResNet50 bf16 NHWC, 1000 classes, grad-of-loss train step",
         "rows": rows,
         "headline_bytes_ratio": rows[-1]["bytes_ratio"],
+        "pallas_lever": pallas_structural(),
         "vit_comparison": vit_cmp,
     }
     with open(args.out, "w", encoding="utf-8") as f:

@@ -36,6 +36,22 @@ def _norm_relu(norm, act, fused, y, **kw):
     return act(norm(**kw)(y))
 
 
+class _Conv1x1Kernel(nn.Module):
+    """Parameter-only stand-in for an ``nn.Conv`` whose matmul executes
+    inside the fused Pallas kernel (ops/fused_matmul.py).  Same param
+    name, shape, dtype, and initializer as ``nn.Conv`` — checkpoints
+    and the pretrained-weights converter see an identical tree."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        return self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (1, 1, in_features, self.features), jnp.float32,
+        )
+
+
 class BottleneckBlock(nn.Module):
     """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut."""
 
@@ -46,7 +62,10 @@ class BottleneckBlock(nn.Module):
     act: Callable = nn.relu
     # Fused path: relu (and the final residual add) execute INSIDE the
     # norm (ops/fused_norm.py) so backward saves no extra activations.
-    fused: bool = False
+    # "pallas" additionally fuses the middle BN's APPLY into the third
+    # (1x1) conv as a Pallas matmul prologue (ops/fused_matmul.py), so
+    # that site's normalized activation never exists in HBM.
+    fused: bool | str = False
 
     @nn.compact
     def __call__(self, x):
@@ -54,8 +73,31 @@ class BottleneckBlock(nn.Module):
         y = self.conv(self.filters, (1, 1))(x)
         y = _norm_relu(self.norm, self.act, self.fused, y)
         y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
-        y = _norm_relu(self.norm, self.act, self.fused, y)
-        y = self.conv(self.filters * 4, (1, 1))(y)
+        if self.fused == "pallas":
+            from ..ops.fused_matmul import bn_relu_matmul
+
+            # Stats in HLO (module auto-named BatchNorm_1, same tree as
+            # the other paths), apply + matmul in the Pallas kernel.
+            scale, bias, mean, var = self.norm()(y, stats_only=True)
+            kernel = _Conv1x1Kernel(
+                self.filters * 4, name="Conv_2"
+            )(y.shape[-1])
+            eps, running = 1e-5, False
+            if hasattr(self.norm, "keywords"):
+                eps = self.norm.keywords.get("epsilon", eps)
+                running = self.norm.keywords.get(
+                    "use_running_average", running
+                )
+            y = bn_relu_matmul(
+                y, scale, bias, mean, var, kernel.astype(y.dtype),
+                eps=eps,
+                # Eval/frozen BN: stats are constants; the backward's
+                # statistics correction must not apply.
+                batch_stats=not running,
+            )
+        else:
+            y = _norm_relu(self.norm, self.act, self.fused, y)
+            y = self.conv(self.filters * 4, (1, 1))(y)
         if residual.shape[-1] != self.filters * 4 or self.strides != 1:
             residual = self.conv(
                 self.filters * 4, (1, 1), (self.strides, self.strides),
@@ -117,7 +159,11 @@ class ResNet(nn.Module):
     # (ops/fused_norm.py) — cuts the HBM bytes that cap v5e throughput
     # (BASELINE.md). Parameter paths are IDENTICAL to the unfused model,
     # so checkpoints and pretrained weights port both ways.
-    fused_bn: bool = False
+    # "pallas" (bottleneck blocks only) additionally fuses the middle
+    # BN's apply into the third 1x1 conv as a Pallas matmul prologue
+    # (ops/fused_matmul.py) — the second HBM byte cut; single-chip
+    # training path (see the SPMD caveat in that module).
+    fused_bn: bool | str = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -135,6 +181,16 @@ class ResNet(nn.Module):
         if self.fused_bn:
             if self.act is not nn.relu:
                 raise ValueError("fused_bn supports act=nn.relu only")
+            if (self.fused_bn == "pallas"
+                    and self.block_cls is not BottleneckBlock):
+                # Only the bottleneck block has the 1x1-conv site the
+                # Pallas prologue fusion targets; silently running the
+                # plain fused path would benchmark the wrong program.
+                raise ValueError(
+                    "fused_bn='pallas' requires block_cls=BottleneckBlock "
+                    "(ResNet-50/101); use fused_bn=True for basic-block "
+                    "models"
+                )
             from ..ops.fused_norm import BatchNorm as FusedBatchNorm
 
             norm_cls = FusedBatchNorm

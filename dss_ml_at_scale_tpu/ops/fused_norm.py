@@ -177,7 +177,7 @@ class BatchNorm(nn.Module):
     bias_init: Callable = nn.initializers.zeros_init()
 
     @nn.compact
-    def __call__(self, x, residual=None):
+    def __call__(self, x, residual=None, *, stats_only: bool = False):
         features = x.shape[-1]
         scale = self.param("scale", self.scale_init, (features,), jnp.float32)
         bias = self.param("bias", self.bias_init, (features,), jnp.float32)
@@ -190,6 +190,31 @@ class BatchNorm(nn.Module):
             lambda s: jnp.ones(s, jnp.float32), (features,),
         )
         relu = self.act == "relu"
+
+        if stats_only:
+            # The Pallas prologue-fusion path (ops/fused_matmul.py):
+            # compute the statistics HERE, in plain HLO — a batch-
+            # sharded mesh still gets the global (sync-BN) reduction —
+            # update the running averages exactly as the applying path
+            # does, and hand (scale, bias, mean, var) to the consuming
+            # kernel, which applies normalize+relu in-register.
+            if self.use_running_average:
+                return scale, bias, ra_mean.value, ra_var.value
+            x32 = x.astype(jnp.float32)
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(x32, axes)
+            var = jnp.mean(jnp.square(x32), axes) - jnp.square(mean)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = (
+                    m * ra_mean.value
+                    + (1.0 - m) * jax.lax.stop_gradient(mean)
+                )
+                ra_var.value = (
+                    m * ra_var.value
+                    + (1.0 - m) * jax.lax.stop_gradient(var)
+                )
+            return scale, bias, mean, var
 
         if self.use_running_average:
             inv = jax.lax.rsqrt(ra_var.value + self.epsilon)

@@ -1,0 +1,349 @@
+"""Parity suite for the Pallas fused BN-apply + 1x1-conv matmul
+(ops/fused_matmul.py) — the second HBM byte-cutting lever.
+
+Same standard as test_fused_norm: the fused op must match the
+*unfused reference composition* (HLO batch-norm -> relu -> matmul,
+differentiated by plain autodiff through the statistics) in forward,
+in every cotangent (dy, dgamma, dbeta, dW, dresidual — including the
+internalized mean/var stats path), in running-statistics updates at
+the model level, and in eval mode.  Runs in Pallas interpret mode on
+the CPU backend (tests/conftest.py forces cpu); the same kernels
+compile for TPU.
+
+Capability parity target: torchvision Bottleneck's
+``conv1x1 ∘ relu ∘ BatchNorm2d`` inside the reference's fine-tuned
+ResNet-50 (``deep_learning/2.distributed-data-loading-petastorm.py``
+:135-165).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dss_ml_at_scale_tpu.ops.fused_matmul import bn_relu_matmul
+
+EPS = 1e-5
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def _reference(y, gamma, beta, w, residual=None):
+    """Plain-HLO composition, stats differentiated by autodiff."""
+    k = y.shape[-1]
+    yf = y.reshape(-1, k).astype(jnp.float32)
+    mean = jnp.mean(yf, 0)
+    var = jnp.mean(jnp.square(yf), 0) - jnp.square(mean)
+    a = (y.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + EPS)
+    a = a * gamma + beta
+    if residual is not None:
+        a = a + residual.astype(jnp.float32)
+    a = jnp.maximum(a, 0.0)
+    out = a.reshape(-1, k) @ w
+    return out.reshape(*y.shape[:-1], w.shape[1])
+
+
+def _fused(y, gamma, beta, w, residual=None):
+    k = y.shape[-1]
+    yf = y.reshape(-1, k).astype(jnp.float32)
+    mean = jnp.mean(yf, 0)
+    var = jnp.mean(jnp.square(yf), 0) - jnp.square(mean)
+    return bn_relu_matmul(
+        y, gamma, beta, mean, var, w, eps=EPS, residual=residual
+    )
+
+
+def _inputs(rng, shape=(4, 6, 6, 24), n=40):
+    k = shape[-1]
+    y = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    res = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    gamma = jnp.asarray(rng.normal(1.0, 0.2, k), jnp.float32)
+    beta = jnp.asarray(rng.normal(0.0, 0.2, k), jnp.float32)
+    w = jnp.asarray(rng.normal(0.0, 0.1, (k, n)), jnp.float32)
+    return y, res, gamma, beta, w
+
+
+@pytest.mark.parametrize("with_res", [False, True])
+def test_forward_matches_reference(rng, with_res):
+    y, res, gamma, beta, w = _inputs(rng)
+    r = res if with_res else None
+    np.testing.assert_allclose(
+        _fused(y, gamma, beta, w, r), _reference(y, gamma, beta, w, r),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("with_res", [False, True])
+def test_gradients_match_reference(rng, with_res):
+    """Every cotangent, including the internalized stats path: the
+    reference differentiates through mean/var as functions of y, so a
+    match here proves the custom VJP's (sum_g + x_hat*sum_gx)/n
+    correction is the true statistics backward."""
+    y, res, gamma, beta, w = _inputs(rng)
+    r = res if with_res else None
+
+    def loss(fn):
+        def inner(args):
+            out = fn(*args[:4], args[4] if with_res else None)
+            return jnp.sum(jnp.sin(out))  # nonconstant cotangent
+        return inner
+
+    args = (y, gamma, beta, w, res)
+    g_ref = jax.grad(loss(_reference))(args)
+    g_fus = jax.grad(loss(_fused))(args)
+    names = ("dy", "dgamma", "dbeta", "dw", "dres")
+    for name, a, b in zip(names, g_ref, g_fus):
+        if name == "dres" and not with_res:
+            continue
+        scale = float(jnp.max(jnp.abs(a))) + 1e-9
+        err = float(jnp.max(jnp.abs(a - b))) / scale
+        assert err < 1e-5, f"{name}: rel err {err}"
+
+
+def test_awkward_shapes_pad_correctly(rng):
+    """K, N, M all non-multiples of the tile sizes: padding must be
+    semantically inert in forward and backward."""
+    y, res, gamma, beta, w = _inputs(rng, shape=(3, 5, 7, 17), n=33)
+
+    np.testing.assert_allclose(
+        _fused(y, gamma, beta, w), _reference(y, gamma, beta, w),
+        rtol=1e-5, atol=1e-5,
+    )
+    g1 = jax.grad(lambda t: jnp.sum(_reference(t, gamma, beta, w)))(y)
+    g2 = jax.grad(lambda t: jnp.sum(_fused(t, gamma, beta, w)))(y)
+    np.testing.assert_allclose(g1, g2, rtol=1e-4, atol=1e-5)
+
+
+def test_running_stats_eval_mode(rng):
+    """Eval uses running statistics: same op, stats from outside."""
+    y, _, gamma, beta, w = _inputs(rng)
+    ra_mean = jnp.asarray(rng.normal(0, 0.5, y.shape[-1]), jnp.float32)
+    ra_var = jnp.asarray(rng.uniform(0.5, 2.0, y.shape[-1]), jnp.float32)
+    out = bn_relu_matmul(y, gamma, beta, ra_mean, ra_var, w, eps=EPS)
+    a = (y - ra_mean) * jax.lax.rsqrt(ra_var + EPS) * gamma + beta
+    expect = jnp.maximum(a, 0.0).reshape(-1, y.shape[-1]) @ w
+    np.testing.assert_allclose(
+        out.reshape(-1, w.shape[1]), expect, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_constant_stats_gradients(rng):
+    """Eval / frozen-BN: with ``batch_stats=False`` the stats are
+    constants and dy must match autodiff through the constant-stats
+    composition (no statistics correction)."""
+    y, _, gamma, beta, w = _inputs(rng)
+    k = y.shape[-1]
+    ra_m = jnp.asarray(rng.normal(0, 0.5, k), jnp.float32)
+    ra_v = jnp.asarray(rng.uniform(0.5, 2.0, k), jnp.float32)
+
+    def ref(t):
+        a = (t - ra_m) * jax.lax.rsqrt(ra_v + EPS) * gamma + beta
+        return jnp.sum(jnp.sin(
+            jnp.maximum(a, 0.0).reshape(-1, k) @ w
+        ))
+
+    def fused(t):
+        return jnp.sum(jnp.sin(bn_relu_matmul(
+            t, gamma, beta, ra_m, ra_v, w, eps=EPS, batch_stats=False
+        )))
+
+    g1, g2 = jax.grad(ref)(y), jax.grad(fused)(y)
+    err = float(jnp.max(jnp.abs(g1 - g2))) / float(jnp.max(jnp.abs(g1)))
+    assert err < 1e-5, f"eval dy rel err {err}"
+
+
+def test_basic_block_models_reject_pallas():
+    from dss_ml_at_scale_tpu.models.resnet import ResNet18
+
+    m = ResNet18(num_classes=4, num_filters=8, dtype=jnp.float32,
+                 fused_bn="pallas")
+    with pytest.raises(ValueError, match="BottleneckBlock"):
+        m.init(jax.random.key(0), jnp.zeros((1, 16, 16, 3)))
+
+
+def test_conv_kernel_4d_accepted(rng):
+    y, _, gamma, beta, w = _inputs(rng)
+    k = y.shape[-1]
+    w4 = w.reshape(1, 1, k, -1)
+    np.testing.assert_allclose(
+        _fused(y, gamma, beta, w4), _fused(y, gamma, beta, w),
+        rtol=1e-6, atol=1e-6,
+    )
+    with pytest.raises(ValueError):
+        bn_relu_matmul(y, gamma, beta, gamma, gamma,
+                       jnp.zeros((3, 3, k, 8)))
+
+
+def test_bf16_pipeline(rng):
+    """bf16 activations / f32 params — the accelerator configuration.
+    Tolerances are bf16-scale."""
+    y, res, gamma, beta, w = _inputs(rng)
+    yb, resb, wb = (y.astype(jnp.bfloat16), res.astype(jnp.bfloat16),
+                    w.astype(jnp.bfloat16))
+    out = _fused(yb, gamma, beta, wb, resb)
+    assert out.dtype == jnp.bfloat16
+    ref = _reference(y, gamma, beta, w, res)
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref, rtol=0.05, atol=0.15
+    )
+
+
+def test_shard_map_batch_sharded_gradients(rng):
+    """The SPMD form: op called per-shard inside shard_map over a
+    batch-sharded mesh (the simulated 8-device slice), global stats
+    passed in, ``axis_name=`` set.  Forward must equal the unsharded
+    reference; every gradient — including dy's global-stats correction
+    and the already-psummed dgamma/dbeta/dW — must match the
+    single-device autodiff-through-stats reference."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    n_dev = len(jax.devices())
+    assert n_dev == 8, "conftest should provide the 8-device slice"
+    B, H, W_, K, N = 16, 4, 4, 24, 40
+    y = jnp.asarray(rng.normal(size=(B, H, W_, K)), jnp.float32)
+    gamma = jnp.asarray(rng.normal(1.0, 0.2, K), jnp.float32)
+    beta = jnp.asarray(rng.normal(0.0, 0.2, K), jnp.float32)
+    w = jnp.asarray(rng.normal(0.0, 0.1, (K, N)), jnp.float32)
+    mesh = Mesh(jax.devices(), ("data",))
+    m_global = B * H * W_
+
+    def stats(t):
+        tf = t.reshape(-1, K).astype(jnp.float32)
+        mean = jnp.mean(tf, 0)
+        var = jnp.mean(jnp.square(tf), 0) - jnp.square(mean)
+        return mean, var
+
+    def sharded(y, gamma, beta, w):
+        mean, var = stats(y)  # global stats, computed outside shard_map
+
+        def per_shard(y_s, gamma, beta, mean, var, w):
+            return bn_relu_matmul(
+                y_s, gamma, beta, mean, var, w, eps=EPS,
+                axis_name="data", global_count=m_global,
+            )
+
+        # check_vma=False: the varying-mesh-axes checker cannot see
+        # through pallas_call's outputs.
+        return shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P("data"), P(), P(), P(), P(), P()),
+            out_specs=P("data"), check_vma=False,
+        )(y, gamma, beta, mean, var, w)
+
+    y_sh = jax.device_put(y, NamedSharding(mesh, P("data")))
+    out = sharded(y_sh, gamma, beta, w)
+    np.testing.assert_allclose(
+        out, _reference(y, gamma, beta, w), rtol=1e-5, atol=1e-5
+    )
+
+    def loss_sharded(args):
+        return jnp.sum(jnp.sin(sharded(*args)))
+
+    def loss_ref(args):
+        return jnp.sum(jnp.sin(_reference(*args)))
+
+    g_sh = jax.grad(loss_sharded)((y_sh, gamma, beta, w))
+    g_ref = jax.grad(loss_ref)((y, gamma, beta, w))
+    for name, a, b in zip(("dy", "dgamma", "dbeta", "dw"), g_ref, g_sh):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-9
+        err = float(jnp.max(jnp.abs(a - jnp.asarray(b)))) / scale
+        assert err < 1e-5, f"{name}: rel err {err}"
+
+
+# ---------------------------------------------------------------------------
+# Model-level: the "pallas" fusion level of ResNet bottleneck blocks
+# ---------------------------------------------------------------------------
+
+def _tiny_resnet(fused):
+    from dss_ml_at_scale_tpu.models.resnet import BottleneckBlock, ResNet
+
+    return ResNet(
+        stage_sizes=[1, 1], block_cls=BottleneckBlock, num_classes=7,
+        num_filters=8, dtype=jnp.float32, fused_bn=fused,
+    )
+
+
+@pytest.fixture(scope="module")
+def model_pair():
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 16, 16, 3)), jnp.float32
+    )
+    m_ref = _tiny_resnet(True)       # HLO fused path (itself flax-proven)
+    m_pal = _tiny_resnet("pallas")
+    v = m_ref.init(jax.random.key(0), x)
+    return m_ref, m_pal, v, x
+
+
+def test_model_param_tree_identical(model_pair):
+    m_ref, m_pal, v, x = model_pair
+    v_pal = m_pal.init(jax.random.key(0), x)
+    assert (jax.tree_util.tree_structure(v)
+            == jax.tree_util.tree_structure(v_pal))
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: a.shape == b.shape, v, v_pal
+    ))
+
+
+def test_model_forward_and_stats_match(model_pair):
+    m_ref, m_pal, v, x = model_pair
+    lr, ur = m_ref.apply(v, x, train=True, mutable=["batch_stats"])
+    lp, up = m_pal.apply(v, x, train=True, mutable=["batch_stats"])
+    np.testing.assert_allclose(lr, lp, rtol=1e-5, atol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                atol=1e-6),
+        ur["batch_stats"], up["batch_stats"],
+    )
+    # Eval mode follows running stats the same way.
+    np.testing.assert_allclose(
+        m_ref.apply(v, x, train=False), m_pal.apply(v, x, train=False),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_model_eval_gradients_match(model_pair):
+    """Frozen-BN gradients (train=False under grad — fine-tuning /
+    saliency): the pallas path must match the HLO path, which
+    differentiates the running-stats composition by plain autodiff."""
+    m_ref, m_pal, v, x = model_pair
+
+    def gsum(m):
+        def f(t):
+            return jnp.sum(m.apply(v, t, train=False))
+        return jax.grad(f)(x)
+
+    g_ref, g_pal = gsum(m_ref), gsum(m_pal)
+    err = float(jnp.max(jnp.abs(g_ref - g_pal))) / (
+        float(jnp.max(jnp.abs(g_ref))) + 1e-9
+    )
+    assert err < 1e-4, f"eval input-grad rel err {err}"
+
+
+def test_model_gradients_match(model_pair):
+    m_ref, m_pal, v, x = model_pair
+    lbl = jnp.asarray([1, 3], jnp.int32)
+
+    def grads(m):
+        def f(params):
+            lg, _ = m.apply(
+                {"params": params, "batch_stats": v["batch_stats"]},
+                x, train=True, mutable=["batch_stats"],
+            )
+            oh = jax.nn.one_hot(lbl, lg.shape[-1])
+            return -jnp.mean(jnp.sum(oh * jax.nn.log_softmax(lg), -1))
+        return jax.grad(f)(v["params"])
+
+    g_ref, g_pal = grads(m_ref), grads(m_pal)
+    errs = jax.tree_util.tree_map(
+        lambda a, b: float(
+            jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(a)) + 1e-9)
+        ),
+        g_ref, g_pal,
+    )
+    worst = max(jax.tree_util.tree_leaves(errs))
+    assert worst < 5e-4, f"worst grad rel err {worst}"
