@@ -69,11 +69,15 @@ _BROADCAST_BUILDS = 0
 def _regression_broadcast_factory():
     global _BROADCAST_BUILDS
     _BROADCAST_BUILDS += 1
+    import os
+
     from ..datagen.regression import gen_data
 
-    # Sized-down stand-in for the ~100 MB regime; deterministic so every
-    # worker materializes the same dataset.
-    return gen_data(1_000_000)
+    # Default is a sized-down stand-in so the fast suite stays fast; the
+    # slow suite sets DSST_BROADCAST_BYTES to run the regime at its real
+    # ~100 MB size (reference ``hyperopt/2...py:90-101``).  Deterministic
+    # either way, so every worker materializes the same dataset.
+    return gen_data(int(os.environ.get("DSST_BROADCAST_BYTES", 1_000_000)))
 
 
 REGRESSION_BROADCAST = Broadcast(factory=_regression_broadcast_factory)

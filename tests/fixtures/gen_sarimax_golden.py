@@ -212,33 +212,88 @@ THETA_POOL = [0.4, -0.25, 0.15, 0.1]
 BETA = [4.0, -2.0, 6.0]
 LOG_S2 = float(np.log(1.3))
 
+# The FULL grid the HPO searches — the reference's space is
+# quniform p in [0,4], d in [0,2], q in [0,4]
+# (``group_apply/02_Fine_Grained_Demand_Forecasting.py:461-465``; the
+# CLI defaults in config/commands.py match) — 75 orders, not just the
+# corners (round-4 verdict: the golden grid covered corner orders only;
+# the transitively-argued middle is now pinned too).
 GRID_ORDERS = [
-    (0, 0, 0), (1, 0, 1), (4, 0, 0), (0, 0, 4), (4, 0, 4),
-    (2, 1, 2), (4, 1, 4), (1, 2, 1), (0, 2, 4), (4, 2, 4),
+    (p, d, q) for p in range(5) for d in range(3) for q in range(5)
 ]
-FIT_ORDERS = [(1, 1, 1), (2, 1, 2), (4, 2, 4), (4, 0, 4), (0, 2, 4)]
+FIT_ORDERS = list(GRID_ORDERS)
+
+# Near-unit-root companion series (d=2-shaped: double-integrated
+# near-unit-root AR innovations): the stiffest numerical regime the HPO
+# visits — phi -> 1 puts the Lyapunov solve near singularity and the
+# likelihood surface near a unit-root ridge.
+NUR_GRID = [(1, 2, 1), (2, 2, 2), (1, 1, 1), (2, 2, 0), (0, 2, 2)]
+NUR_PHI = [0.97, -0.1]
+
+
+def make_nur_series(n: int = 120, n_valid: int = 112, seed: int = 7):
+    """Near-unit-root series: double-integrated AR(1) with phi = 0.97
+    innovations plus exog — the d=2, phi -> 1 regime the round-4 verdict
+    asked to pin."""
+    rng = np.random.default_rng(seed)
+    step = (np.arange(n) >= 30).astype(float)
+    ramp = np.arange(n) / n
+    exog = np.stack([step, ramp], axis=1)
+    eps = rng.normal(0, 1.0, n + 50)
+    ar = np.zeros(n + 50)
+    for t in range(1, n + 50):
+        ar[t] = 0.97 * ar[t - 1] + eps[t]
+    u = np.cumsum(np.cumsum(0.05 * ar[50:]))  # d=2 integrated
+    y = exog @ np.array([4.0, 6.0]) + 20.0 + u
+    return y, exog, n_valid
+
+
+def _pinned_case(y, exog, order, phi_pool, theta_pool, n_valid,
+                 beta=None):
+    p, d, q = order
+    beta = BETA if beta is None else beta
+    phi, theta = phi_pool[:p], theta_pool[:q]
+    ll, pred = oracle_filter(
+        y, exog, np.array(beta), np.array(phi), np.array(theta),
+        float(np.exp(LOG_S2)), d, n_valid,
+    )
+    return {
+        "order": [p, d, q],
+        "beta": list(beta),
+        "phi": phi,
+        "theta": theta,
+        "log_sigma2": LOG_S2,
+        "loglike": ll,
+        "predict": pred.tolist(),
+    }
 
 
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--merge-existing", action="store_true",
+        help="reuse fit bars already present in sarimax_golden.json "
+        "(same series/params by construction); compute only missing "
+        "orders — lets the 75-order grid build incrementally",
+    )
+    args = ap.parse_args()
+    path = Path(__file__).with_name("sarimax_golden.json")
+    prior_fits: dict[tuple, float] = {}
+    prior_nur: dict | None = None
+    if args.merge_existing and path.exists():
+        prior = json.loads(path.read_text())
+        prior_fits = {
+            tuple(f["order"]): f["loglike"] for f in prior.get("fits", [])
+        }
+        prior_nur = prior.get("nur")
+
     y, exog, n_valid = make_series()
-    cases = []
-    for (p, d, q) in GRID_ORDERS:
-        phi, theta = PHI_POOL[:p], THETA_POOL[:q]
-        ll, pred = oracle_filter(
-            y, exog, np.array(BETA), np.array(phi), np.array(theta),
-            float(np.exp(LOG_S2)), d, n_valid,
-        )
-        cases.append(
-            {
-                "order": [p, d, q],
-                "beta": BETA,
-                "phi": phi,
-                "theta": theta,
-                "log_sigma2": LOG_S2,
-                "loglike": ll,
-                "predict": pred.tolist(),
-            }
-        )
+    cases = [
+        _pinned_case(y, exog, order, PHI_POOL, THETA_POOL, n_valid)
+        for order in GRID_ORDERS
+    ]
     # Diffuse-initialization pin: explosive AR(1), d=0.
     ll, pred = oracle_filter(
         y, exog, np.array(BETA), np.array([1.3]), np.array([]),
@@ -257,11 +312,53 @@ def main() -> None:
         }
     )
 
-    fits = []
-    for order in FIT_ORDERS:
-        ll_best, _ = oracle_fit(y, exog, order, n_valid)
-        fits.append({"order": list(order), "loglike": ll_best})
-        print(f"oracle fit {order}: loglike {ll_best:.4f}")
+    from multiprocessing import Pool
+
+    todo = [o for o in FIT_ORDERS if o not in prior_fits]
+    print(f"fit bars: {len(prior_fits)} reused, {len(todo)} to compute",
+          flush=True)
+    with Pool() as pool:
+        fit_lls = pool.starmap(
+            _fit_one, [(y, exog, order, n_valid) for order in todo]
+        )
+    computed = dict(zip(todo, fit_lls)) | prior_fits
+    fits = [
+        {"order": list(order), "loglike": computed[order]}
+        for order in FIT_ORDERS
+    ]
+    for f in fits:
+        print(f"oracle fit {tuple(f['order'])}: loglike {f['loglike']:.4f}")
+
+    # Near-unit-root companion block (own series, k_exog=2).
+    if prior_nur is not None:
+        nur_block = prior_nur
+        print("nur block reused")
+    else:
+        ny, nexog, n_nvalid = make_nur_series()
+        nur_cases = [
+            _pinned_case(ny, nexog, order, NUR_PHI, THETA_POOL, n_nvalid,
+                         beta=[3.0, 5.0])
+            for order in NUR_GRID
+        ]
+        with Pool() as pool:
+            nur_lls = pool.starmap(
+                _fit_one,
+                [(ny, nexog, order, n_nvalid) for order in NUR_GRID],
+            )
+        nur_fits = [
+            {"order": list(order), "loglike": ll}
+            for order, ll in zip(NUR_GRID, nur_lls)
+        ]
+        for f in nur_fits:
+            print(f"nur oracle fit {tuple(f['order'])}: "
+                  f"loglike {f['loglike']:.4f}")
+        nur_block = {
+            "n_valid": int(n_nvalid),
+            "y": ny.tolist(),
+            "exog": nexog.tolist(),
+            "cases": nur_cases,
+            "fits": nur_fits,
+        }
 
     out = {
         "kappa": KAPPA,
@@ -270,10 +367,17 @@ def main() -> None:
         "exog": exog.tolist(),
         "cases": cases,
         "fits": fits,
+        "nur": nur_block,
     }
-    path = Path(__file__).with_name("sarimax_golden.json")
     path.write_text(json.dumps(out))
-    print(f"wrote {path} ({len(cases)} likelihood cases, {len(fits)} fit bars)")
+    print(f"wrote {path} ({len(cases)}+{len(nur_block['cases'])} "
+          f"likelihood cases, {len(fits)}+{len(nur_block['fits'])} "
+          f"fit bars)")
+
+
+def _fit_one(y, exog, order, n_valid) -> float:
+    ll_best, _ = oracle_fit(y, exog, order, n_valid)
+    return ll_best
 
 
 if __name__ == "__main__":

@@ -107,26 +107,32 @@ def main() -> None:
             ids.extend(int(v) for v in batch["id"])
     result["ids"] = sorted(ids)
 
-    # -- control plane: HostTrials sweep against the *other* process ------
-    addr_file = workdir / "worker_addr"
+    # -- control plane: HostTrials sweep driven by process 0 against a
+    # worker served by EVERY other process (N-1 workers at N>2) --------
     done_file = workdir / "sweep_done"
-    if topo.process_index == 1:
+    if topo.process_index > 0:
         from dss_ml_at_scale_tpu.parallel.trials import serve_trial_worker
 
         server = serve_trial_worker("127.0.0.1:0", block=False)
         host, port = server.address
-        addr_file.write_text(f"{host}:{port}")
+        (workdir / f"worker_addr_{topo.process_index}").write_text(
+            f"{host}:{port}"
+        )
         _wait_for(done_file)
     else:
-        _wait_for(addr_file)
+        addrs = []
+        for i in range(1, topo.process_count):
+            f = workdir / f"worker_addr_{i}"
+            _wait_for(f)
+            addrs.append(f.read_text())
         from dss_ml_at_scale_tpu.hpo import fmin, hp
         from dss_ml_at_scale_tpu.parallel import HostTrials
 
-        trials = HostTrials([addr_file.read_text()], parallelism=1)
+        trials = HostTrials(addrs, parallelism=len(addrs))
         best = fmin(
             "dss_ml_at_scale_tpu.hpo.objectives:quadratic",
             {"x": hp.uniform("x", -5.0, 5.0)},
-            max_evals=4,
+            max_evals=2 * len(addrs) + 2,
             trials=trials,
             rstate=np.random.default_rng(0),
         )
