@@ -16,9 +16,12 @@ from pathlib import Path
 
 
 def build_classifier_model(name: str, *, num_classes: int,
-                           torch_padding: bool, fused_bn: bool = True):
+                           torch_padding: bool,
+                           fused_bn: bool | str = True):
     """The train/predict/export/serve-shared model factory
-    ("resnet50" | "tiny" | "vit-t16" | "vit-s16" | "vit-tiny")."""
+    ("resnet50" | "tiny" | "tiny-bottleneck" | "vit-t16" | "vit-s16" |
+    "vit-tiny").  ``fused_bn`` accepts the ResNet levels: False, True
+    (HLO fused), or "pallas" (prologue-fused bottleneck)."""
     if name.startswith("vit"):
         # torch_padding / fused_bn are conv/BN concepts; a ViT has
         # neither, so the flags are inert for these choices.
@@ -36,10 +39,14 @@ def build_classifier_model(name: str, *, num_classes: int,
     if name == "resnet50":
         return ResNet50(num_classes=num_classes, torch_padding=torch_padding,
                         fused_bn=fused_bn)
-    from ..models.resnet import ResNet, ResNetBlock
+    from ..models.resnet import BottleneckBlock, ResNet, ResNetBlock
 
+    # "tiny-bottleneck": same CI geometry with the ResNet-50 block
+    # structure — the one small model that exercises fused_bn="pallas".
     return ResNet(
-        stage_sizes=[1, 1], block_cls=ResNetBlock,
+        stage_sizes=[1, 1],
+        block_cls=(BottleneckBlock if name == "tiny-bottleneck"
+                   else ResNetBlock),
         num_classes=num_classes, num_filters=8,
         torch_padding=torch_padding, fused_bn=fused_bn,
     )

@@ -375,7 +375,8 @@ def register_train(sub: argparse._SubParsersAction) -> None:
     tr.add_argument("--crop", type=int, default=224)
     tr.add_argument(
         "--model",
-        choices=["resnet50", "tiny", "vit-t16", "vit-s16", "vit-tiny"],
+        choices=["resnet50", "tiny", "tiny-bottleneck", "vit-t16",
+                 "vit-s16", "vit-tiny"],
         default="resnet50",
     )
     tr.add_argument(
@@ -400,6 +401,14 @@ def register_train(sub: argparse._SubParsersAction) -> None:
         "VJP (ops/fused_norm.py): same math and parameter tree, ~30%% "
         "fewer HBM bytes per step — the v5e throughput lever. "
         "--no-fused-bn falls back to flax BatchNorm",
+    )
+    tr.add_argument(
+        "--pallas-fused", action="store_true",
+        help="second byte lever on top of --fused-bn (bottleneck models "
+        "only): the middle BN's apply fused into the 1x1 conv as a "
+        "Pallas matmul prologue (ops/fused_matmul.py) — the normalized "
+        "activation never exists in HBM; same parameter tree, "
+        "single-chip training path",
     )
     tr.add_argument(
         "--eval-topk", type=int, nargs="*", default=[],
@@ -464,6 +473,34 @@ def _cmd_train(args: argparse.Namespace) -> int:
     from ..data.transform import imagenet_transform_spec
     from ..parallel import ClassifierTask, Trainer, TrainerConfig
     from ..runtime import initialize_distributed, local_topology, make_mesh
+
+    if getattr(args, "pallas_fused", False):
+        if not args.fused_bn:
+            print("--pallas-fused builds on the fused path; drop "
+                  "--no-fused-bn")
+            return 1
+        if args.model not in ("resnet50", "tiny-bottleneck"):
+            # ViT has no BN (the flag would be silently inert); basic-
+            # block ResNets have no 1x1 site (the model would raise a
+            # deep flax traceback).  Loud and early instead.
+            print("--pallas-fused applies to bottleneck ResNets only "
+                  "(resnet50, tiny-bottleneck); drop the flag for "
+                  f"--model {args.model}")
+            return 1
+        import jax
+
+        if jax.device_count() > 1 and jax.devices()[0].platform != "cpu":
+            # Compiled pallas_call has no GSPMD partitioning rule yet —
+            # multi-chip would compile-error or replicate the batch.
+            # (CPU interpret mode lowers to plain HLO, which GSPMD
+            # partitions fine — the simulated-mesh CI path.)
+            print("--pallas-fused is single-chip for now; use plain "
+                  "--fused-bn for multi-chip training")
+            return 1
+        # Scoring paths map this back to the (math-identical) HLO fused
+        # model via resolve_checkpoint's bool(); training uses the
+        # Pallas prologue-fused program.
+        args.fused_bn = "pallas"
 
     initialize_distributed(coordinator_address=args.coordinator)
     # Each process reads a disjoint shard (the reference's

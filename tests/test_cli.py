@@ -151,6 +151,57 @@ def test_train_cli_tiny(tmp_path, capsys, devices8, image_dtype):
 
 
 @pytest.mark.slow
+def test_train_cli_pallas_fused(tmp_path, capsys, devices8):
+    """`--pallas-fused` trains the prologue-fused bottleneck program
+    end to end through the CLI (interpret-mode kernels on CPU) and the
+    checkpoint scores through the standard predict path (which maps
+    fused_bn='pallas' back to the math-identical HLO fused model)."""
+    from test_end_to_end import _jpeg
+    import pyarrow as pa
+
+    from dss_ml_at_scale_tpu.data import write_delta
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 32)
+    table = pa.table({
+        "content": pa.array([_jpeg(rng, l) for l in labels], type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    data = tmp_path / "images"
+    write_delta(table, data, max_rows_per_file=16)
+    ckpt = tmp_path / "ckpt"
+
+    assert main([
+        "train", "--data", str(data), "--model", "tiny-bottleneck",
+        "--pallas-fused", "--num-classes", "4", "--crop", "32",
+        "--batch-size", "16", "--epochs", "1",
+        "--learning-rate", "0.01", "--checkpoint-dir", str(ckpt),
+    ]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["steps"] == 2  # 32 rows // 16
+    assert np.isfinite(summary["train_loss"])
+    meta = json.loads((ckpt / "dsst_model.json").read_text())
+    assert meta["fused_bn"] == "pallas"
+
+    out = tmp_path / "preds"
+    assert main([
+        "predict", "--data", str(data), "--checkpoint-dir", str(ckpt),
+        "--out", str(out),
+    ]) == 0
+    # Misconfigurations are loud, not silent: --no-fused-bn conflicts,
+    # ViT has no BN (flag would be inert), basic blocks have no 1x1
+    # site (would raise a deep flax traceback otherwise).
+    for bad in (["--model", "tiny-bottleneck", "--no-fused-bn"],
+                ["--model", "vit-tiny"],
+                ["--model", "tiny"]):
+        assert main([
+            "train", "--data", str(data), "--pallas-fused",
+            "--num-classes", "4", "--crop", "32", "--batch-size", "16",
+            "--epochs", "1", *bad,
+        ]) == 1
+
+
+@pytest.mark.slow
 def test_train_cli_pretrained(tmp_path, capsys, devices8):
     # Fine-tune from a synthetic torchvision-layout state dict
     # (reference 2...py:150 fine-tunes IMAGENET1K_V2).
