@@ -1197,6 +1197,22 @@ def child_group() -> None:
         result["sequential_sample_skus"] = len(sample_skus)
         result["sequential_est_seconds_for_g"] = round(est_total, 1)
         result["speedup_vs_sequential_est"] = round(est_total / wall, 2)
+        # The reference's actual execution shape: 50 groups as Spark
+        # tasks over 2 single-core workers (``group_apply/02...py:
+        # 516-528``; cluster config in the tutorial).  Modeled with the
+        # measured per-SKU host-path cost — i.e. granting the reference
+        # our kernels — against this panel's wall-clock for the SAME
+        # 50-SKU slice.  The one-XLA-launch-vs-many-tasks thesis,
+        # quantified.
+        per_sku_seq = seq_wall / len(sample_skus)
+        result["reference_shape_model"] = {
+            "shape": "50 groups / 2 workers (applyInPandas-style)",
+            "modeled_seconds": round(per_sku_seq * 50 / 2, 1),
+            "panel_seconds_for_50": round(wall * 50 / groups_done, 1),
+            "speedup": round(
+                (per_sku_seq * 50 / 2) / (wall * 50 / groups_done), 2
+            ),
+        }
     except Exception:
         result["failed"] = True
         result["note"] = traceback.format_exc(limit=5)

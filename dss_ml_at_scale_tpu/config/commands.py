@@ -487,19 +487,12 @@ def _cmd_train(args: argparse.Namespace) -> int:
                   "(resnet50, tiny-bottleneck); drop the flag for "
                   f"--model {args.model}")
             return 1
-        import jax
-
-        if jax.device_count() > 1 and jax.devices()[0].platform != "cpu":
-            # Compiled pallas_call has no GSPMD partitioning rule yet —
-            # multi-chip would compile-error or replicate the batch.
-            # (CPU interpret mode lowers to plain HLO, which GSPMD
-            # partitions fine — the simulated-mesh CI path.)
-            print("--pallas-fused is single-chip for now; use plain "
-                  "--fused-bn for multi-chip training")
-            return 1
         # Scoring paths map this back to the (math-identical) HLO fused
         # model via resolve_checkpoint's bool(); training uses the
-        # Pallas prologue-fused program.
+        # Pallas prologue-fused program.  (The multi-chip guard runs
+        # AFTER initialize_distributed below: touching the backend here
+        # would break jax.distributed.initialize, and the pre-init
+        # local count is the wrong topology anyway.)
         args.fused_bn = "pallas"
 
     initialize_distributed(coordinator_address=args.coordinator)
@@ -507,6 +500,19 @@ def _cmd_train(args: argparse.Namespace) -> int:
     # cur_shard=rank / shard_count=WORLD, 2...py:249-250); the mesh
     # assembles per-process rows into the global batch.
     topo = local_topology()
+
+    if args.fused_bn == "pallas":
+        import jax
+
+        if (topo.global_device_count > 1
+                and jax.devices()[0].platform != "cpu"):
+            # Compiled pallas_call has no GSPMD partitioning rule yet —
+            # multi-chip would compile-error or replicate the batch.
+            # (CPU interpret mode lowers to plain HLO, which GSPMD
+            # partitions fine — the simulated-mesh CI path.)
+            print("--pallas-fused is single-chip for now; use plain "
+                  "--fused-bn for multi-chip training")
+            return 1
 
     table = DeltaTable(args.data)
     rows = table.num_records()
