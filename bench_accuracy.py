@@ -63,6 +63,13 @@ def main() -> int:
         help="force the CPU backend (accuracy is hardware-independent; "
         "use when the accelerator is unavailable)",
     )
+    ap.add_argument(
+        "--pallas-fused", action="store_true",
+        help="train the Pallas prologue-fused bottleneck program "
+        "(ops/fused_matmul.py) instead of the HLO fused basic-block "
+        "model — the convergence proof for the second byte lever "
+        "(single-chip; interpret-mode kernels on CPU)",
+    )
     args = ap.parse_args()
 
     import tempfile
@@ -79,7 +86,11 @@ def main() -> int:
 
     from dss_ml_at_scale_tpu.data import DeltaTable, batch_loader
     from dss_ml_at_scale_tpu.data.transform import imagenet_transform_spec
-    from dss_ml_at_scale_tpu.models.resnet import ResNet, ResNetBlock
+    from dss_ml_at_scale_tpu.models.resnet import (
+        BottleneckBlock,
+        ResNet,
+        ResNetBlock,
+    )
     from dss_ml_at_scale_tpu.parallel import ClassifierTask, Trainer, TrainerConfig
     from dss_ml_at_scale_tpu.runtime import make_mesh
     from dss_ml_at_scale_tpu.tracking import RunStore
@@ -94,12 +105,28 @@ def main() -> int:
                  label_noise=args.label_noise)
 
     spec = imagenet_transform_spec(crop=64)
+    if args.pallas_fused and len(jax.devices()) > 1 and (
+            jax.devices()[0].platform != "cpu"):
+        # Same refusal as the dsst-train CLI: compiled pallas_call has
+        # no GSPMD partitioning rule; a multi-chip mesh would
+        # compile-error or replicate the batch and corrupt the artifact.
+        print(json.dumps({
+            "failed": True,
+            "note": "--pallas-fused is single-chip; run without it or "
+                    "on one device",
+        }))
+        return 1
     model = ResNet(
-        stage_sizes=[1, 1], block_cls=ResNetBlock, num_filters=16,
+        stage_sizes=[1, 1],
+        # --pallas-fused: bottleneck blocks + the Pallas prologue-fused
+        # program (single-chip), so the accuracy band also guards the
+        # second byte lever's training path end to end.
+        block_cls=BottleneckBlock if args.pallas_fused else ResNetBlock,
+        num_filters=16,
         num_classes=args.classes,
         # The production default: the accuracy band then also guards the
         # fused custom-VJP training path end to end.
-        fused_bn=True,
+        fused_bn="pallas" if args.pallas_fused else True,
     )
     task = ClassifierTask(model=model, tx=optax.adam(1e-3))
     store = RunStore(str(workdir / "runs"), "accuracy_proof", run_name="train")
@@ -138,6 +165,9 @@ def main() -> int:
         best_acc = max((c["val_acc"] for c in curve), default=0.0)
         out = {
             "device": jax.devices()[0].device_kind,
+            "model_variant": ("pallas-fused bottleneck"
+                             if args.pallas_fused
+                             else "HLO-fused basic block"),
             "classes": args.classes,
             "n_train": args.n_train,
             "n_val": args.n_val,
