@@ -44,6 +44,8 @@ PY
     echo "$(date -u +%H:%M:%S) bench rc=$?" >> tpu_watchdog.log
     timeout 2400 python bench_accuracy.py --label-noise 0 --out ACCURACY_onchip_r5.json >> tpu_watchdog.log 2>&1
     echo "$(date -u +%H:%M:%S) accuracy rc=$?" >> tpu_watchdog.log
+    timeout 1800 python bench_accuracy.py --label-noise 0 --pallas-fused --out ACCURACY_pallas_onchip_r5.json >> tpu_watchdog.log 2>&1
+    echo "$(date -u +%H:%M:%S) pallas accuracy rc=$?" >> tpu_watchdog.log
     timeout 900 python scaling_model.py --bench-json BENCH_onchip_r5.json >> tpu_watchdog.log 2>&1
     echo "$(date -u +%H:%M:%S) scaling rc=$?" >> tpu_watchdog.log
     timeout 600 python smoke_two_device_trials.py >> tpu_watchdog.log 2>&1
