@@ -66,6 +66,12 @@ class BottleneckBlock(nn.Module):
     # (1x1) conv as a Pallas matmul prologue (ops/fused_matmul.py), so
     # that site's normalized activation never exists in HBM.
     fused: bool | str = False
+    # Batch-sharded SPMD form of the pallas site: when a mesh is given,
+    # the kernel runs per-shard inside shard_map over `pallas_axis`
+    # (stats stay global HLO; the op psums its backward sums — see
+    # ops/fused_matmul.py).  None = single-device pallas_call.
+    pallas_mesh: Any = None
+    pallas_axis: str = "data"
 
     @nn.compact
     def __call__(self, x):
@@ -88,13 +94,42 @@ class BottleneckBlock(nn.Module):
                 running = self.norm.keywords.get(
                     "use_running_average", running
                 )
-            y = bn_relu_matmul(
-                y, scale, bias, mean, var, kernel.astype(y.dtype),
-                eps=eps,
-                # Eval/frozen BN: stats are constants; the backward's
-                # statistics correction must not apply.
-                batch_stats=not running,
-            )
+            kernel = kernel.astype(y.dtype)
+            # Init traces the body with a tiny (often 1-sample) batch
+            # that cannot satisfy shard_map's divisibility; the
+            # single-device path is math-identical, so init always
+            # takes it.
+            if self.pallas_mesh is not None and not self.is_initializing():
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                axis = self.pallas_axis
+                m_global = y.shape[0] * y.shape[1] * y.shape[2]
+
+                def per_shard(y_s, scale, bias, mean, var, kernel):
+                    return bn_relu_matmul(
+                        y_s, scale, bias, mean, var, kernel, eps=eps,
+                        batch_stats=not running, axis_name=axis,
+                        global_count=m_global,
+                    )
+
+                # check_vma=False: the varying-mesh-axes checker cannot
+                # see through pallas_call.
+                y = shard_map(
+                    per_shard, mesh=self.pallas_mesh,
+                    in_specs=(P(axis, None, None, None),
+                              P(), P(), P(), P(), P()),
+                    out_specs=P(axis, None, None, None),
+                    check_vma=False,
+                )(y, scale, bias, mean, var, kernel)
+            else:
+                y = bn_relu_matmul(
+                    y, scale, bias, mean, var, kernel,
+                    eps=eps,
+                    # Eval/frozen BN: stats are constants; the
+                    # backward's statistics correction must not apply.
+                    batch_stats=not running,
+                )
         else:
             y = _norm_relu(self.norm, self.act, self.fused, y)
             y = self.conv(self.filters * 4, (1, 1))(y)
@@ -161,9 +196,12 @@ class ResNet(nn.Module):
     # so checkpoints and pretrained weights port both ways.
     # "pallas" (bottleneck blocks only) additionally fuses the middle
     # BN's apply into the third 1x1 conv as a Pallas matmul prologue
-    # (ops/fused_matmul.py) — the second HBM byte cut; single-chip
-    # training path (see the SPMD caveat in that module).
+    # (ops/fused_matmul.py) — the second HBM byte cut.  Single-device
+    # by default; pass pallas_mesh (+ pallas_axis) for the
+    # batch-sharded shard_map form under a mesh.
     fused_bn: bool | str = False
+    pallas_mesh: Any = None
+    pallas_axis: str = "data"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -191,6 +229,13 @@ class ResNet(nn.Module):
                     "(ResNet-50/101); use fused_bn=True for basic-block "
                     "models"
                 )
+        if self.pallas_mesh is not None and self.fused_bn != "pallas":
+            # Same silent-wrong-program hazard in the other direction.
+            raise ValueError(
+                "pallas_mesh= requires fused_bn='pallas' (a mesh with "
+                "the HLO fused path would be silently ignored)"
+            )
+        if self.fused_bn:
             from ..ops.fused_norm import BatchNorm as FusedBatchNorm
 
             norm_cls = FusedBatchNorm
@@ -213,6 +258,12 @@ class ResNet(nn.Module):
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
+                # (pallas implies BottleneckBlock — validated above.)
+                block_kw = (
+                    {"pallas_mesh": self.pallas_mesh,
+                     "pallas_axis": self.pallas_axis}
+                    if self.fused_bn == "pallas" else {}
+                )
                 x = self.block_cls(
                     filters=self.num_filters * 2**i,
                     strides=strides,
@@ -220,6 +271,7 @@ class ResNet(nn.Module):
                     norm=norm,
                     act=self.act,
                     fused=self.fused_bn,
+                    **block_kw,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -45,12 +45,13 @@ per-channel sums are exact as-is.  Under a batch-sharded mesh, call
 the op inside ``shard_map`` with ``axis_name=`` — the backward then
 ``psum``s the sums feeding ``dy`` so every shard uses the global
 statistics backward, while dgamma/dbeta/dW stay shard-local (the
-shard_map transpose of replicated inputs reduces them); tested under
-the simulated 8-device mesh in tests/test_fused_matmul.py.  The
-model-level default for multi-chip training remains the HLO fused
-path (``fused_bn=True``), which GSPMD partitions automatically;
-"pallas" is the single-chip headline configuration until the model
-grows a shard_map integration.
+shard_map transpose of replicated inputs reduces them).  The model
+integrates this as ``ResNet(fused_bn="pallas", pallas_mesh=mesh)``
+(models/resnet.py), validated end to end by the driver's multichip
+dryrun and tests/test_fused_matmul.py on the simulated 8-device mesh.
+The HLO fused path (``fused_bn=True``) remains the default for
+multi-chip training; compiled-TPU multi-chip pallas awaits real
+multi-chip hardware to validate.
 
 Capability parity: the composition equals the reference's
 ``Conv2d(1x1, bias=False) ∘ ReLU ∘ BatchNorm2d`` sequence inside
