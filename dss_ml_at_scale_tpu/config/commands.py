@@ -1131,6 +1131,12 @@ def _cmd_trial_worker(args: argparse.Namespace) -> int:
         block=True,
         secret=_rpc_secret(args),
         allow_insecure=args.insecure,
+        # The user (or an orchestrator reading the pipe) needs the
+        # OS-assigned port on stdout NOW — serve_forever() never
+        # returns, so without the explicit flush a block-buffered pipe
+        # would hold the line forever. Library callers get the module
+        # logger instead.
+        announce=lambda m: print(m, flush=True),
     )
     return 0
 
@@ -1324,8 +1330,10 @@ def _resolve_lr_schedule(args: argparse.Namespace, meta: dict,
 
 def _finish_tracker(tracker, params: dict | None = None,
                     metrics: dict | None = None, step: int | None = None):
-    """The one place a CLI run is closed: final params/metrics, FINISHED
-    status, and the 'run ->' pointer the user needs to find it."""
+    """The one place a CLI run is closed: final params/metrics, the
+    telemetry archive (counter snapshot + span JSONL — what `dsst
+    telemetry` reads back), FINISHED status, and the 'run ->' pointer
+    the user needs to find it."""
     global _active_tracker
     if tracker is None:
         return
@@ -1333,6 +1341,12 @@ def _finish_tracker(tracker, params: dict | None = None,
         tracker.log_params(params)
     if metrics:
         tracker.log_metrics(metrics, step=step)
+    from .. import telemetry
+
+    tracker.log_telemetry()
+    span_log = telemetry.get_span_log()
+    if span_log.events():
+        tracker.log_text(span_log.to_jsonl(), "spans.jsonl")
     tracker.finish()
     if tracker is _active_tracker:
         _active_tracker = None
@@ -1520,6 +1534,100 @@ def _cmd_runs_show(args: argparse.Namespace) -> int:
     return 0
 
 
+def register_telemetry(sub: argparse._SubParsersAction) -> None:
+    tl = sub.add_parser(
+        "telemetry",
+        help="inspect a run's archived telemetry snapshot and export "
+        "span logs as Chrome/Perfetto traces",
+    )
+    tl.add_argument(
+        "--run", default=None, metavar="DIR",
+        help="run directory (<root>/<experiment>/<run_id>, as `runs "
+        "list` points at) whose telemetry.json to print",
+    )
+    tl.add_argument(
+        "--json", action="store_true",
+        help="print the raw snapshot JSON instead of a table",
+    )
+    tl.add_argument(
+        "--export-perfetto", default=None, metavar="OUT",
+        help="write a Chrome trace_event JSON (loads in ui.perfetto.dev) "
+        "converted from a span JSONL (--spans, or the --run's archived "
+        "artifacts/spans.jsonl)",
+    )
+    tl.add_argument(
+        "--spans", default=None, metavar="JSONL",
+        help="span JSONL to convert (default: <--run>/artifacts/spans.jsonl)",
+    )
+    tl.set_defaults(fn=_cmd_telemetry)
+
+
+def _cmd_telemetry(args: argparse.Namespace) -> int:
+    did_something = False
+    rc = 0
+    # Snapshot first: a missing/empty span archive must not swallow a
+    # perfectly readable telemetry.json.
+    if args.run:
+        snap_file = Path(args.run) / "telemetry.json"
+        if not snap_file.exists():
+            print(f"no telemetry.json under {args.run} (was the run "
+                  "finished by a telemetry-aware dsst?)")
+            rc = 1
+        else:
+            snapshot = json.loads(snap_file.read_text())
+            if args.json:
+                print(json.dumps(snapshot, indent=1))
+            else:
+                _print_snapshot_table(snapshot)
+            did_something = True
+    if args.export_perfetto:
+        from ..telemetry import export_perfetto
+
+        spans = args.spans or (
+            str(Path(args.run) / "artifacts" / "spans.jsonl")
+            if args.run else None
+        )
+        if spans is None:
+            print("--export-perfetto needs --spans (or --run with an "
+                  "archived spans.jsonl)")
+            return 2
+        if not Path(spans).exists():
+            print(f"no span log at {spans}")
+            return 1
+        n = export_perfetto(spans, args.export_perfetto)
+        print(f"perfetto trace: {n} events -> {args.export_perfetto}")
+        did_something = True
+    if not did_something and rc == 0:
+        print("nothing to do: pass --run and/or --export-perfetto")
+        return 2
+    return rc
+
+
+def _print_snapshot_table(snapshot: dict) -> None:
+    rows = []
+    for m in snapshot.get("metrics", []):
+        labels = m.get("labels") or {}
+        name = m["name"] + (
+            "{" + ",".join(f'{k}={v}' for k, v in labels.items()) + "}"
+            if labels else ""
+        )
+        if m.get("type") == "histogram":
+            count = m.get("count", 0)
+            mean = (m.get("sum", 0.0) / count) if count else 0.0
+            value = (f"count={count} sum={m.get('sum', 0.0):.6g} "
+                     f"mean={mean:.6g}")
+        else:
+            value = f"{m.get('value', 0.0):.6g}"
+        rows.append((name, m.get("type", "?"), value))
+    if not rows:
+        print("(empty snapshot)")
+        return
+    width = max(len(r[0]) for r in rows)
+    print(f"{'METRIC':<{width}}  {'TYPE':<9}  VALUE")
+    for name, kind, value in rows:
+        print(f"{name:<{width}}  {kind:<9}  {value}")
+
+
 def register_all(sub: argparse._SubParsersAction) -> None:
     register_datagen(sub)
     register_forecast(sub)
@@ -1533,6 +1641,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_hpo(sub)
     register_trial_worker(sub)
     register_runs(sub)
+    register_telemetry(sub)
     from .pipeline import register_pipeline
 
     register_pipeline(sub)

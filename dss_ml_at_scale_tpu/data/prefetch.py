@@ -11,11 +11,13 @@ sharded arrays while the current step runs achieves the overlap.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Iterable, Iterator
 
 import jax
 from jax.sharding import Mesh
 
+from .. import telemetry
 from ..runtime.mesh import shard_batch_to_mesh
 
 
@@ -36,10 +38,20 @@ def prefetch_to_mesh(
     """
     if depth < 1:
         raise ValueError("depth must be >= 1")
+    # This generator is pull-driven, so buffer occupancy is `depth` by
+    # construction and carries no signal; the meaningful number is the
+    # host cost of sharding + enqueueing each batch to the mesh (the
+    # dispatch is async — time here is host work, not device wait).
+    shard_hist = telemetry.histogram(
+        "prefetch_shard_seconds",
+        "host time to shard + enqueue one batch to the mesh",
+    )
     buf = collections.deque()
     it = iter(it)
     for batch in it:
+        t0 = time.perf_counter()
         buf.append(shard_batch_to_mesh(batch, mesh, axis=axis, specs=specs))
+        shard_hist.observe(time.perf_counter() - t0)
         if len(buf) >= depth:
             yield buf.popleft()
     while buf:

@@ -35,6 +35,7 @@ import contextlib
 import itertools
 import queue
 import threading
+import time
 from typing import Iterator, Sequence
 
 import numpy as np
@@ -189,6 +190,19 @@ class ParquetShardReader:
         self._results = results = queue.Queue(maxsize=self.results_queue_size)
         work = self._unit_stream()
         lock = threading.Lock()
+        # Decode-pipeline health gauges: queue depth says whether workers
+        # keep ahead of the consumer; stall time is the consumer-side
+        # cost when they don't (the "is training input-bound?" number).
+        from .. import telemetry
+
+        queue_gauge = telemetry.gauge(
+            "reader_queue_depth", "decoded row groups waiting in the "
+            "results queue at last consumer read"
+        )
+        stall_total = telemetry.counter(
+            "reader_stall_seconds_total",
+            "cumulative consumer wait on the decode queue",
+        )
         self._threads = [
             threading.Thread(
                 target=self._worker, args=(work, lock, results), daemon=True,
@@ -201,7 +215,10 @@ class ParquetShardReader:
         live = len(self._threads)
         try:
             while live:
+                wait_t0 = time.perf_counter()
                 item = results.get()
+                stall_total.inc(time.perf_counter() - wait_t0)
+                queue_gauge.set(results.qsize())
                 if item is _SENTINEL:
                     live -= 1
                     continue

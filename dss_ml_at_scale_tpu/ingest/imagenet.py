@@ -240,17 +240,29 @@ def ingest_image_dataset(
     written = False
     batch: list[dict] = []
 
+    from .. import telemetry
+
+    rows_total = telemetry.counter(
+        "ingest_rows_total", "rows written by ingest_image_dataset"
+    )
+    bytes_total = telemetry.counter(
+        "ingest_bytes_total", "content bytes written by ingest_image_dataset"
+    )
+
     def flush(batch: Sequence[dict], first: bool) -> None:
         tbl = pa.Table.from_pylist(list(batch), schema=schema)
         write_delta(tbl, table_path, mode=mode if first else "append")
+        rows_total.inc(len(batch))
+        bytes_total.inc(sum(r["length"] for r in batch))
 
-    for rec in rows():
-        batch.append(rec)
-        if len(batch) >= rows_per_fragment:
+    with telemetry.span("ingest", root=str(data_root)):
+        for rec in rows():
+            batch.append(rec)
+            if len(batch) >= rows_per_fragment:
+                flush(batch, not written)
+                written = True
+                batch = []
+        if batch or not written:
             flush(batch, not written)
-            written = True
-            batch = []
-    if batch or not written:
-        flush(batch, not written)
     (Path(table_path) / "labels.json").write_text(json.dumps(vocab))
     return DeltaTable(table_path)

@@ -252,7 +252,17 @@ class PipelinedTask:
     def batch_size_of(self, batch) -> int:
         """Examples per batch = n_micro × micro_batch (Trainer hook)."""
         x = batch["x"]
-        return int(x.shape[0]) * int(x.shape[1])
+        n_micro = int(x.shape[0])
+        # The bubble fraction is fixed by (n_micro, n_stages); publish it
+        # whenever batch geometry is (re)observed so operators see when a
+        # too-small microbatch count is wasting ticks.
+        from .. import telemetry
+
+        telemetry.gauge(
+            "pipeline_utilization",
+            "GPipe schedule utilization n_micro/(n_micro+n_stages-1)",
+        ).set(pipeline_utilization(n_micro, self.n_stages))
+        return n_micro * int(x.shape[1])
 
     def init_state(self, rng, sample_batch):
         from .trainer import TrainState

@@ -36,6 +36,7 @@ from flax import struct
 from flax.core import FrozenDict
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry
 from ..data.prefetch import prefetch_to_mesh
 from ..models.metrics import (
     cross_entropy_loss,
@@ -466,7 +467,29 @@ class Trainer:
         sign = 1.0 if cfg.best_mode == "max" else -1.0
         step = int(state.step)  # host-side mirror, synced once before the loop
         data_exhausted = False
-        step_timer = StepTimer()
+        # Telemetry series (process registry): step time, data wait,
+        # throughput, compile events. Handles hoisted out of the loop; the
+        # per-step cost is two clock reads + histogram observes + a cache
+        # probe — no device sync added to the hot path.
+        step_hist = telemetry.histogram(
+            "train_step_seconds", "wall time between dispatched train steps"
+        )
+        wait_hist = telemetry.histogram(
+            "train_data_wait_seconds",
+            "per-step time blocked on the input pipeline",
+        )
+        throughput_gauge = telemetry.gauge(
+            "train_throughput_rows_per_sec",
+            "last epoch's global training throughput",
+        )
+        compiles = telemetry.CompileTracker(
+            train_step,
+            telemetry.counter(
+                "train_compile_events_total",
+                "train_step executable compiles (first step + retraces)",
+            ),
+        )
+        step_timer = StepTimer(observer=step_hist.observe)
         tracing = False
 
         for epoch in range(start_epoch, cfg.max_epochs):
@@ -476,15 +499,18 @@ class Trainer:
                     "of %d", step, epoch, cfg.max_epochs,
                 )
                 break
+            t0_wall = time.time()
             t0 = time.perf_counter()
             metrics = {}
             epoch_steps = 0
             for _ in range(steps_per_epoch):
+                wait_t0 = time.perf_counter()
                 try:
                     batch = next(device_batches)
                 except StopIteration:
                     data_exhausted = True
                     break
+                wait_hist.observe(time.perf_counter() - wait_t0)
                 if cfg.profile_dir is not None and not tracing and (
                     step >= cfg.profile_start_step
                 ):
@@ -495,6 +521,7 @@ class Trainer:
                 epoch_steps += 1
                 step += 1  # host-side mirror of state.step: no device sync
                 step_timer.tick()
+                compiles.update()
                 if tracing and step >= trace_stop_at:
                     jax.block_until_ready(state.params)
                     jax.profiler.stop_trace()
@@ -506,20 +533,30 @@ class Trainer:
                 break
             jax.block_until_ready(state.params)
             dt = time.perf_counter() - t0
+            telemetry.get_span_log().record(
+                "train_epoch", t0_wall, dt, epoch=epoch, steps=epoch_steps
+            )
+            images_per_sec = (
+                epoch_steps
+                * per_process_batch
+                * self.topology.process_count
+                / dt
+            )
+            throughput_gauge.set(images_per_sec)
             epoch_summary = {
                 "epoch": epoch,
                 "epoch_time_s": dt,
-                "images_per_sec": epoch_steps
-                * per_process_batch
-                * self.topology.process_count
-                / dt,
+                "images_per_sec": images_per_sec,
                 **step_timer.summary(),
                 **{k: float(v) for k, v in metrics.items()},
             }
             step_timer.reset()
 
             if val_data_factory is not None:
-                epoch_summary.update(self._evaluate(eval_step, state, val_data_factory))
+                with telemetry.span("eval", epoch=epoch):
+                    epoch_summary.update(
+                        self._evaluate(eval_step, state, val_data_factory)
+                    )
 
             history.append(epoch_summary)
             self._log(
@@ -546,11 +583,12 @@ class Trainer:
                     }
                 else:
                     save_metrics = None
-                manager.save(
-                    step,
-                    args=_ocp().args.StandardSave(_to_pytree(state)),
-                    metrics=save_metrics,
-                )
+                with telemetry.span("checkpoint", step=step):
+                    manager.save(
+                        step,
+                        args=_ocp().args.StandardSave(_to_pytree(state)),
+                        metrics=save_metrics,
+                    )
         if tracing:
             jax.block_until_ready(state.params)
             jax.profiler.stop_trace()

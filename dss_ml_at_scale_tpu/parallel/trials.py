@@ -18,6 +18,7 @@ bit-identical to sequential TPE — same as SparkTrials vs Trials).
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -25,7 +26,10 @@ from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
 import jax
 
+from .. import telemetry
 from ..hpo.fmin import Trials, _call_objective, _log_trial
+
+log = logging.getLogger(__name__)
 
 
 class DeviceTrials(Trials):
@@ -55,12 +59,15 @@ class DeviceTrials(Trials):
             if self.pin_devices:
                 device = device_pool.get()
                 try:
-                    with jax.default_device(device):
+                    with jax.default_device(device), telemetry.span(
+                        "trial", tid=tid, device=str(device)
+                    ):
                         result = _call_objective(objective, space, point)
                 finally:
                     device_pool.put(device)
             else:
-                result = _call_objective(objective, space, point)
+                with telemetry.span("trial", tid=tid):
+                    result = _call_objective(objective, space, point)
             return tid, point, result, t0
 
         _run_async_pool(
@@ -80,6 +87,10 @@ def _run_async_pool(
     ``evaluate(tid, point) -> (tid, point, result, t0)`` runs on pool
     threads and must not touch the trial store.
     """
+    outcomes = telemetry.counter(
+        "hpo_trials_total", "completed HPO trials by outcome",
+        labels=("status",),
+    )
     submitted = len(trials.trials)
     with ThreadPoolExecutor(max_workers=parallelism) as pool:
         pending = set()
@@ -92,6 +103,9 @@ def _run_async_pool(
             for fut in done:
                 tid, point, result, t0 = fut.result()
                 trials._record(tid, point, result, t0)
+                outcomes.labels(
+                    status=str(result.get("status", "unknown"))
+                ).inc()
                 if tracker is not None:
                     _log_trial(tracker, tid, point, result)
     trials.trials.sort(key=lambda t: t["tid"])
@@ -136,14 +150,22 @@ def serve_trial_worker(
     block: bool = True,
     secret: bytes | str | None = None,
     allow_insecure: bool = False,
+    announce=None,
 ):
     """Run a trial-evaluation worker (one per host, like a Spark executor).
 
-    Exposes ``evaluate({"objective": ref, "args": kwargs}) -> result`` and
-    ``ping``. Objectives run under the trial-result protocol, so a raising
-    objective returns a ``fail`` result instead of killing the worker.
-    Non-loopback binds require ``secret`` (HMAC handshake; see
+    Exposes ``evaluate({"objective": ref, "args": kwargs}) -> result``,
+    ``ping``, and the telemetry pull handlers (``telemetry_snapshot`` /
+    ``telemetry_spans``) so a coordinator can collect this host's
+    counters and spans over the same control plane. Objectives run under
+    the trial-result protocol, so a raising objective returns a ``fail``
+    result instead of killing the worker. Non-loopback binds require
+    ``secret`` (HMAC handshake; see
     :mod:`dss_ml_at_scale_tpu.runtime.rpc`) unless ``allow_insecure``.
+
+    ``announce`` is called with the bound ``host:port`` line (the CLI
+    passes ``print`` — a user starting a worker needs the OS-assigned
+    port on stdout); library callers default to the module logger.
     """
     from ..hpo.fmin import call_with_protocol
     from ..runtime.rpc import RpcServer
@@ -152,17 +174,29 @@ def serve_trial_worker(
 
     def _evaluate(payload):
         fn = resolve_objective(payload["objective"])
-        return call_with_protocol(fn, payload["args"])
+        # Worker-side trial span: this is what a coordinator's
+        # telemetry_spans pull sees for the host's trial timeline.
+        with telemetry.span("trial", objective=payload["objective"]):
+            return call_with_protocol(fn, payload["args"])
 
     server = RpcServer(
-        {"evaluate": _evaluate, "ping": lambda _: "pong"},
+        {
+            "evaluate": _evaluate,
+            "ping": lambda _: "pong",
+            **telemetry.rpc_handlers(),
+        },
         host or "127.0.0.1",
         int(port),
         secret=secret,
         allow_insecure=allow_insecure,
     )
-    print(f"trial worker listening on {server.address[0]}:{server.address[1]}",
-          flush=True)
+    message = (
+        f"trial worker listening on {server.address[0]}:{server.address[1]}"
+    )
+    if announce is not None:
+        announce(message)
+    else:
+        log.info("%s", message)
     if block:
         server.serve_forever()
         return None
@@ -255,13 +289,16 @@ class HostTrials(Trials):
                     "error": "no live workers (all busy, dead, or timed out)",
                 }, t0
             try:
-                result = rpc_call(
-                    worker,
-                    "evaluate",
-                    {"objective": ref, "args": space_eval(space, point)},
-                    timeout=self.rpc_timeout,
-                    secret=self.secret,
-                )
+                # Driver-side trial span: covers the whole remote round
+                # trip (the worker records its own compute-only span).
+                with telemetry.span("trial", tid=tid, worker=str(worker)):
+                    result = rpc_call(
+                        worker,
+                        "evaluate",
+                        {"objective": ref, "args": space_eval(space, point)},
+                        timeout=self.rpc_timeout,
+                        secret=self.secret,
+                    )
             except RpcRemoteError as e:
                 # The worker responded — it is healthy; the handler raised
                 # (e.g. unresolvable ref). Trial fails, worker returns.

@@ -1,0 +1,102 @@
+"""Unified telemetry: metrics registry, spans, device stats, exports.
+
+The observability layer the ROADMAP north-star requires: one
+process-local place where training (step time / throughput / data wait /
+compiles), HPO (per-trial spans and outcomes), the ingest/decode
+pipeline (queue depth, stall time), and serving (request latency, error
+counts) all meter themselves — renderable as Prometheus text for a
+``GET /metrics`` scrape, archivable as JSON into a run's
+:class:`~dss_ml_at_scale_tpu.tracking.RunStore`, and exportable as a
+Chrome/Perfetto trace of the whole run.
+
+Module-level helpers (``counter``/``gauge``/``histogram``/``span``) hit
+the process-default registry and span log, so instrumentation points
+never thread a registry object through APIs; tests and embedders that
+need isolation construct their own :class:`MetricsRegistry`/
+:class:`SpanLog`.
+"""
+
+from __future__ import annotations
+
+from .device import CompileTracker, DeviceMonitor, device_memory_stats
+from .export import collect_remote_snapshots, rpc_handlers, write_exports
+from .registry import (
+    DEFAULT_BUCKETS,
+    MetricFamily,
+    MetricsRegistry,
+    log_buckets,
+)
+from .spans import SpanLog, export_perfetto, to_perfetto
+
+__all__ = [
+    "CompileTracker",
+    "DEFAULT_BUCKETS",
+    "DeviceMonitor",
+    "MetricFamily",
+    "MetricsRegistry",
+    "SpanLog",
+    "collect_remote_snapshots",
+    "counter",
+    "device_memory_stats",
+    "export_perfetto",
+    "gauge",
+    "get_registry",
+    "get_span_log",
+    "histogram",
+    "log_buckets",
+    "render_prometheus",
+    "reset",
+    "rpc_handlers",
+    "snapshot",
+    "span",
+    "to_perfetto",
+    "write_exports",
+]
+
+_registry = MetricsRegistry()
+_span_log = SpanLog()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-default registry every helper below writes to."""
+    return _registry
+
+
+def get_span_log() -> SpanLog:
+    """The process-default span log."""
+    return _span_log
+
+
+def counter(name: str, help: str = "", labels=()) -> MetricFamily:
+    return _registry.counter(name, help, labels)
+
+
+def gauge(name: str, help: str = "", labels=()) -> MetricFamily:
+    return _registry.gauge(name, help, labels)
+
+
+def histogram(name: str, help: str = "", labels=(),
+              buckets=None) -> MetricFamily:
+    return _registry.histogram(name, help, labels, buckets)
+
+
+def span(name: str, **args):
+    """``with telemetry.span("decode"): ...`` on the default span log."""
+    return _span_log.span(name, **args)
+
+
+def snapshot() -> dict:
+    return _registry.snapshot()
+
+
+def render_prometheus() -> str:
+    return _registry.render_prometheus()
+
+
+def reset() -> None:
+    """Zero every default-registry series and clear the span log.
+
+    Test isolation and epoch-boundary resets; registrations survive.
+    """
+    _registry.reset()
+    _span_log.clear()

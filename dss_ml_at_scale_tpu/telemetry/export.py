@@ -1,0 +1,93 @@
+"""Telemetry export: process-0 file writes + RPC pull of remote hosts.
+
+Matches the tracking store's multi-host discipline (SURVEY §5.5): every
+process *accumulates* telemetry, but only the coordinator (process 0)
+*writes* exports — non-coordinators' snapshots travel over the
+:mod:`~dss_ml_at_scale_tpu.runtime.rpc` control plane instead, pulled by
+the coordinator where one is present (:func:`collect_remote_snapshots`
+against workers serving :func:`rpc_handlers`, as
+``dsst trial-worker`` processes do).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+
+def write_exports(directory: str | os.PathLike, *, registry=None,
+                  span_log=None, coordinator_only: bool = True) -> list:
+    """Write ``telemetry.json`` + ``metrics.prom`` + ``spans.jsonl`` +
+    ``trace.json`` (Perfetto) under ``directory``.
+
+    Returns the written paths — empty on non-coordinator processes when
+    ``coordinator_only`` (the default, matching ``RunStore``).
+    """
+    if coordinator_only:
+        import jax
+
+        if jax.process_index() != 0:
+            return []
+    from . import get_registry, get_span_log
+    from .spans import to_perfetto
+
+    registry = registry if registry is not None else get_registry()
+    span_log = span_log if span_log is not None else get_span_log()
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+
+    written = []
+
+    def _emit(name: str, text: str) -> None:
+        path = directory / name
+        path.write_text(text)
+        written.append(path)
+
+    _emit("telemetry.json", json.dumps(registry.snapshot(), indent=1))
+    _emit("metrics.prom", registry.render_prometheus())
+    events = span_log.events()
+    _emit("spans.jsonl", "".join(json.dumps(e) + "\n" for e in events))
+    _emit("trace.json", json.dumps(to_perfetto(events)))
+    return written
+
+
+def rpc_handlers(registry=None, span_log=None) -> dict:
+    """Handlers a :class:`~dss_ml_at_scale_tpu.runtime.rpc.RpcServer`
+    can merge in so a coordinator can pull this host's telemetry."""
+
+    def _snapshot(_payload):
+        from . import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        return reg.snapshot()
+
+    def _spans(_payload):
+        from . import get_span_log
+
+        log = span_log if span_log is not None else get_span_log()
+        return log.events()
+
+    return {"telemetry_snapshot": _snapshot, "telemetry_spans": _spans}
+
+
+def collect_remote_snapshots(workers, *, secret=None,
+                             timeout: float = 30.0) -> dict:
+    """Pull ``telemetry_snapshot`` from each ``host:port`` worker.
+
+    Returns ``{address: snapshot_dict}``; an unreachable worker maps to
+    ``{"error": "..."}`` instead of failing the whole collection (the
+    coordinator is usually mid-teardown when it calls this).
+    """
+    from ..runtime.rpc import rpc_call
+
+    out = {}
+    for addr in workers:
+        try:
+            out[addr] = rpc_call(
+                addr, "telemetry_snapshot", None,
+                timeout=timeout, secret=secret,
+            )
+        except Exception as e:
+            out[addr] = {"error": f"{type(e).__name__}: {e}"}
+    return out

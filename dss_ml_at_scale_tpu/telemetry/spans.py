@@ -1,0 +1,146 @@
+"""Host-side span/event log with Chrome/Perfetto ``trace_event`` export.
+
+``jax.profiler`` traces answer "what did the *device* do" at XLA-op
+granularity, but a whole-run picture — data wait vs. device step vs.
+checkpoint vs. eval, across epochs and trials — needs cheap host-side
+spans that survive without a profiler session. :func:`SpanLog.span`
+builds on :func:`~dss_ml_at_scale_tpu.utils.profiling.annotate`, so the
+same name shows up inside a jax trace when one IS active, while the
+host-side record always lands here.
+
+Events are plain dicts (JSONL on disk)::
+
+    {"name", "ts", "dur", "pid", "tid", "args"}   # ts/dur in seconds
+
+and :func:`to_perfetto` converts a list of them to Chrome trace_event
+JSON (``ph: "X"`` complete events, microsecond timestamps) that loads
+directly in ``ui.perfetto.dev`` or ``chrome://tracing``.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from ..utils.profiling import annotate
+
+
+class SpanLog:
+    """Bounded in-memory span recorder with optional JSONL tee.
+
+    ``capacity`` bounds memory (oldest events evicted); pass ``path`` to
+    also append every event to a JSONL file as it is recorded (the
+    crash-safe export — the in-memory ring is for snapshots).
+    """
+
+    def __init__(self, capacity: int = 100_000,
+                 path: str | os.PathLike | None = None):
+        self._events: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._file = None
+        if path is not None:
+            Path(path).parent.mkdir(parents=True, exist_ok=True)
+            self._file = open(path, "a", encoding="utf-8")
+
+    def record(self, name: str, ts: float, dur: float, **args) -> dict:
+        """Record one complete span (``ts`` epoch seconds, ``dur`` seconds)."""
+        event = {
+            "name": name,
+            "ts": ts,
+            "dur": dur,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if args:
+            event["args"] = args
+        with self._lock:
+            self._events.append(event)
+            if self._file is not None:
+                self._file.write(json.dumps(event) + "\n")
+                self._file.flush()
+        return event
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args) -> Iterator[None]:
+        """``with log.span("decode"): ...`` — records wall time here AND
+        labels the region in any active ``jax.profiler`` trace."""
+        t0 = time.time()
+        p0 = time.perf_counter()
+        try:
+            with annotate(name):
+                yield
+        finally:
+            self.record(name, t0, time.perf_counter() - p0, **args)
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def dump_jsonl(self, path: str | os.PathLike) -> int:
+        """Write the in-memory events to a JSONL file; returns the count."""
+        events = self.events()
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        return len(events)
+
+    def to_jsonl(self) -> str:
+        return "".join(json.dumps(e) + "\n" for e in self.events())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def to_perfetto(events: Iterable[dict]) -> dict:
+    """Span dicts → Chrome ``trace_event`` JSON object.
+
+    Emits ``ph: "X"`` complete events with microsecond ``ts``/``dur``,
+    sorted by ``ts`` so timestamps are monotonic (some consumers require
+    it). The result is ``json.dump``-able as-is.
+    """
+    trace_events = []
+    for e in sorted(events, key=lambda e: float(e.get("ts", 0.0))):
+        trace_events.append({
+            "name": str(e.get("name", "?")),
+            "cat": "dsst",
+            "ph": "X",
+            "ts": round(float(e.get("ts", 0.0)) * 1e6, 3),
+            "dur": round(max(float(e.get("dur", 0.0)), 0.0) * 1e6, 3),
+            "pid": int(e.get("pid", 0)),
+            "tid": int(e.get("tid", 0)),
+            "args": dict(e.get("args", {})),
+        })
+    return {"displayTimeUnit": "ms", "traceEvents": trace_events}
+
+
+def export_perfetto(jsonl_path: str | os.PathLike,
+                    out_path: str | os.PathLike) -> int:
+    """Convert a span JSONL file to a Chrome trace file.
+
+    Returns the number of events converted. The output loads in
+    ``ui.perfetto.dev`` ("Open trace file") or ``chrome://tracing``.
+    """
+    events = []
+    with open(jsonl_path, encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                events.append(json.loads(line))
+    trace = to_perfetto(events)
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(json.dumps(trace))
+    return len(events)

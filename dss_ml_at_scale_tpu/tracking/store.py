@@ -55,6 +55,7 @@ class RunStore:
         self.active = not coordinator_only or jax.process_index() == 0
         self.run_id = run_id or uuid.uuid4().hex[:12]
         self.path = Path(root) / experiment / self.run_id
+        self._closed = False
         if not self.active:
             return
         if self.path.exists() and not resume and run_id is not None:
@@ -100,19 +101,52 @@ class RunStore:
             return
         (self.path / "artifacts" / name).write_text(text)
 
-    def finish(self, status: str = "FINISHED") -> None:
+    def log_telemetry(self, snapshot: Mapping[str, Any] | None = None) -> None:
+        """Archive a telemetry snapshot as this run's ``telemetry.json``.
+
+        ``snapshot`` defaults to the process registry's current state
+        (:func:`dss_ml_at_scale_tpu.telemetry.snapshot`) so callers at
+        run end archive their final counters with one call.
+        """
         if not self.active:
             return
+        if snapshot is None:
+            from .. import telemetry
+
+            snapshot = telemetry.snapshot()
+        self._write_json("telemetry.json", snapshot)
+
+    def finish(self, status: str = "FINISHED") -> None:
+        """Close the run. Idempotent: a second finish (e.g. the crash
+        handler racing a normal close) is a no-op instead of a
+        double-close of the metrics handle."""
+        if not self.active or self._closed:
+            return
+        self._closed = True
         meta = json.loads((self.path / "meta.json").read_text())
         meta.update(status=status, end_time=_now())
         self._write_json("meta.json", meta)
         self._metrics.close()
+
+    # -- context manager (finish() may never run on a hard crash; `with`
+    # scopes the metrics handle to the block and stamps the outcome) ------
+
+    def __enter__(self) -> "RunStore":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.finish("FAILED" if exc_type is not None else "FINISHED")
+        return False
 
     # -- reading back -----------------------------------------------------
 
     def metrics(self) -> list[dict]:
         if not self.active:
             return []
+        if not self._closed:
+            # Read-back while the append handle is still open: flush so
+            # the reader sees every logged line.
+            self._metrics.flush()
         with open(self.path / "metrics.jsonl", encoding="utf-8") as f:
             return [json.loads(line) for line in f if line.strip()]
 

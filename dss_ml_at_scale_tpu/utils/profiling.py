@@ -22,9 +22,10 @@ This module provides both:
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import time
-from typing import Iterator
+from typing import Callable, Iterator
 
 import jax
 
@@ -37,9 +38,15 @@ def trace(logdir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
     XProf and shows the XLA op timeline on device plus host-side Python
     activity — the diagnostic the reference's epoch print stood in for.
     """
-    options = jax.profiler.ProfileOptions()
-    options.host_tracer_level = host_tracer_level
-    jax.profiler.start_trace(logdir, profiler_options=options)
+    # ProfileOptions is newer than some installed jaxlibs; fall back to a
+    # plain trace (default host tracer level) when it's absent.
+    options_cls = getattr(jax.profiler, "ProfileOptions", None)
+    if options_cls is not None:
+        options = options_cls()
+        options.host_tracer_level = host_tracer_level
+        jax.profiler.start_trace(logdir, profiler_options=options)
+    else:
+        jax.profiler.start_trace(logdir)
     try:
         yield
     finally:
@@ -64,11 +71,20 @@ class StepTimer:
     unless asked).
     """
 
-    def __init__(self, capacity: int = 4096, skip_first_interval: bool = True):
+    def __init__(self, capacity: int = 4096, skip_first_interval: bool = True,
+                 observer: Callable[[float], None] | None = None):
         self.capacity = capacity
-        self._times: list[float] = []
+        # deque(maxlen=...) evicts in O(1); list.pop(0) was O(n) per step
+        # once at capacity — a growing per-step tax on long runs.
+        self._times: collections.deque[float] = collections.deque(
+            maxlen=capacity
+        )
         self._last: float | None = None
         self._skip_next = skip_first_interval
+        # Called once per RECORDED interval (compile-skipped intervals are
+        # not observed) — the telemetry histogram hook, kept out of the
+        # eviction-bounded ring so exported stats cover the whole run.
+        self._observer = observer
 
     def reset(self, *, skip_next_interval: bool = False) -> None:
         self._times.clear()
@@ -81,9 +97,10 @@ class StepTimer:
             if self._skip_next:
                 self._skip_next = False
             else:
-                if len(self._times) >= self.capacity:
-                    self._times.pop(0)
-                self._times.append(now - self._last)
+                dt = now - self._last
+                self._times.append(dt)
+                if self._observer is not None:
+                    self._observer(dt)
         self._last = now
 
     @property

@@ -16,8 +16,11 @@ Design points (TPU-shaped):
   scorer ``dsst predict`` uses (``config/checkpoints.make_scorer``);
   class names come from the label vocabulary persisted WITH the
   checkpoint — predictions match ``dsst predict`` by construction.
-- **Endpoints**: ``GET /healthz`` (model/step/status), ``POST /predict``
-  with either a raw JPEG body (``Content-Type: image/jpeg``) or JSON
+- **Endpoints**: ``GET /healthz`` (model/step/status), ``GET /metrics``
+  (Prometheus text exposition of the process telemetry registry —
+  request-latency histograms, error counters, plus whatever else this
+  process metered), ``POST /predict`` with either a raw JPEG body
+  (``Content-Type: image/jpeg``) or JSON
   ``{"instances": ["<base64 jpeg>", ...]}`` → JSON
   ``{"predictions": [{"pred_index", "pred_prob", "pred_label"}, ...]}``.
 """
@@ -27,7 +30,10 @@ from __future__ import annotations
 import base64
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import telemetry
 
 
 class Predictor:
@@ -72,6 +78,19 @@ class Predictor:
         self._score = make_scorer(task, variables)
         self._jnp = jnp
         self._np = np
+        # Scoring-path telemetry: latency per predict() call (decode +
+        # score + host fetch), images scored, and failures. Handles are
+        # resolved once here, not per request.
+        self._predict_hist = telemetry.histogram(
+            "predict_batch_seconds",
+            "Predictor.predict latency (decode + score + fetch)",
+        )
+        self._predict_images = telemetry.counter(
+            "predict_images_total", "images scored by Predictor.predict"
+        )
+        self._predict_errors = telemetry.counter(
+            "predict_errors_total", "Predictor.predict calls that raised"
+        )
         # Warm the one executable so the first request pays no compile.
         self._score(
             jnp.zeros((self.micro_batch, self.crop, self.crop, 3),
@@ -80,6 +99,17 @@ class Predictor:
 
     def predict(self, jpegs: list[bytes]) -> list[dict]:
         """Decoded, padded, chunked scoring of a request's images."""
+        t0 = time.perf_counter()
+        try:
+            out = self._predict(jpegs)
+        except BaseException:
+            self._predict_errors.inc()
+            raise
+        self._predict_hist.observe(time.perf_counter() - t0)
+        self._predict_images.inc(len(jpegs))
+        return out
+
+    def _predict(self, jpegs: list[bytes]) -> list[dict]:
         np, jnp = self._np, self._jnp
         content = np.empty(len(jpegs), object)
         content[:] = jpegs
@@ -123,11 +153,32 @@ def make_server(predictor: Predictor, host: str = "127.0.0.1",
     into memory (low-risk at the 127.0.0.1 default bind, but the caps
     make the exposure explicit and configurable)."""
 
+    # Registered before the first request so a scrape of a fresh server
+    # already declares the series (# TYPE lines render for empty
+    # families). One histogram labeled by path, one error counter by
+    # status code.
+    request_hist = telemetry.histogram(
+        "serving_request_seconds", "HTTP request latency", labels=("path",)
+    )
+    error_counter = telemetry.counter(
+        "serving_errors_total", "HTTP 4xx/5xx responses", labels=("code",)
+    )
+
+    _known_paths = frozenset(("/healthz", "/metrics", "/predict"))
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet by default; errors still raise
             pass
 
+        def _observe(self, t0: float) -> None:
+            # Unknown paths collapse to one label so a port scan can't
+            # explode series cardinality.
+            path = self.path if self.path in _known_paths else "other"
+            request_hist.labels(path=path).observe(time.perf_counter() - t0)
+
         def _json(self, code: int, payload: dict) -> None:
+            if code >= 400:
+                error_counter.labels(code=str(code)).inc()
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -135,18 +186,43 @@ def make_server(predictor: Predictor, host: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(body)
 
+        def _metrics(self) -> None:
+            body = telemetry.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):
-            if self.path == "/healthz":
-                self._json(200, {
-                    "status": "ok",
-                    "model": predictor.meta.get("model"),
-                    "checkpoint_step": predictor.step,
-                    "crop": predictor.crop,
-                })
-            else:
-                self._json(404, {"error": f"no route {self.path}"})
+            t0 = time.perf_counter()
+            try:
+                if self.path == "/healthz":
+                    self._json(200, {
+                        "status": "ok",
+                        "model": predictor.meta.get("model"),
+                        "checkpoint_step": predictor.step,
+                        "crop": predictor.crop,
+                    })
+                elif self.path == "/metrics":
+                    self._metrics()
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+            finally:
+                # Mirror do_POST: a client hanging up mid-response must
+                # not drop the request from the latency histogram.
+                self._observe(t0)
 
         def do_POST(self):
+            t0 = time.perf_counter()
+            try:
+                self._post()
+            finally:
+                self._observe(t0)
+
+        def _post(self):
             if self.path != "/predict":
                 self._json(404, {"error": f"no route {self.path}"})
                 return

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Lint: no bare ``print(`` inside the library.
+
+Every user-facing line must flow through an accountable channel —
+telemetry (metered), tracking (archived), or ``logging`` (filterable).
+A bare ``print`` in library code bypasses all three and corrupts
+machine-parseable CLI stdout. The CLI surface (``config/``: cli,
+commands, pipeline — whose *job* is stdout) is the one exemption.
+
+AST-based so strings, comments, and ``pprint``-style names never false
+positive; ``file=sys.stderr`` prints in library code are violations too
+(use logging). Runs in tier-1 via ``tests/test_no_print.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PACKAGE = Path(__file__).resolve().parents[1] / "dss_ml_at_scale_tpu"
+
+# The CLI surface: stdout is its contract.
+ALLOWED_FIRST_PARTS = {"config"}
+
+
+def find_violations(package: Path = PACKAGE) -> list[str]:
+    violations: list[str] = []
+    for path in sorted(package.rglob("*.py")):
+        rel = path.relative_to(package)
+        if rel.parts[0] in ALLOWED_FIRST_PARTS:
+            continue
+        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                violations.append(
+                    f"{rel}:{node.lineno}: bare print() — route through "
+                    "telemetry/tracking/logging"
+                )
+    return violations
+
+
+def main() -> int:
+    violations = find_violations()
+    for line in violations:
+        sys.stderr.write(line + "\n")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

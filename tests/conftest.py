@@ -20,15 +20,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: the golden-fit/panel tests are dominated
-# by XLA compiles of the same programs every run; caching them on disk
-# (keyed by HLO hash — safe across edits) cuts repeat suite time.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# NO persistent compilation cache here, deliberately: this jaxlib's CPU
+# backend crashes the whole process (SIGSEGV/SIGABRT, not an exception)
+# when it DEserializes a cached executable — the first in-process
+# cache hit (e.g. the second fit of a resume test compiling the
+# identical train_step) aborts the suite. Compile-time savings are not
+# worth a hard crash; re-enable only after verifying
+# serialize→deserialize round-trips on the installed jaxlib.
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -143,6 +143,34 @@ def test_unknown_route_404(server):
     assert _request(port, "POST", "/nope")[0] == 404
 
 
+def test_metrics_scrape_includes_predictor_series(server):
+    """GET /metrics on a REAL Predictor-backed server: the scoring-path
+    histograms registered in Predictor.__init__ render alongside the
+    HTTP-layer series (the stub-server scrape lives in
+    test_telemetry.py)."""
+    import http.client
+
+    port, jpegs = server
+    status, _ = _request(
+        port, "POST", "/predict", body=jpegs[0],
+        content_type="image/jpeg",
+    )
+    assert status == 200
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    ctype = resp.getheader("Content-Type", "")
+    conn.close()
+    assert resp.status == 200
+    assert ctype.startswith("text/plain")
+    assert "# TYPE serving_request_seconds histogram" in text
+    assert 'serving_request_seconds_bucket{path="/predict",le="+Inf"}' in text
+    assert "# TYPE predict_batch_seconds histogram" in text
+    assert "predict_batch_seconds_count" in text
+    assert "predict_images_total" in text
+
+
 def test_serving_matches_dsst_predict(server, trained_ckpt, tmp_path):
     """The guarantee the module docstring makes: the server scores the
     SAME pixels as dsst predict (shared transform spec — resize-256
