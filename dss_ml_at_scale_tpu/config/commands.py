@@ -1422,9 +1422,11 @@ def register_serve(sub: argparse._SubParsersAction) -> None:
     sv = sub.add_parser(
         "serve",
         help="HTTP inference server over a trained checkpoint: "
-        "GET /healthz, POST /predict (raw JPEG body or JSON "
-        '{"instances": ["<base64 jpeg>", ...]}); one fixed-shape '
-        "compiled scorer, label names from the trained vocabulary",
+        "GET /healthz + /readyz, POST /predict (raw JPEG body or JSON "
+        '{"instances": ["<base64 jpeg>", ...]}); scheduler-mediated '
+        "scoring (bounded admission queue, cross-request dynamic "
+        "batching into one fixed-shape compiled scorer, graceful "
+        "drain), label names from the trained vocabulary",
     )
     sv.add_argument("--checkpoint-dir", required=True,
                     help="a dsst train checkpoint dir (dsst_model.json)")
@@ -1433,12 +1435,39 @@ def register_serve(sub: argparse._SubParsersAction) -> None:
     sv.add_argument("--step", type=int, default=None,
                     help="explicit checkpoint step (default: best, else latest)")
     sv.add_argument("--micro-batch", type=int, default=8,
-                    help="compiled scoring batch; requests pad/chunk to it")
+                    help="compiled scoring batch; the batcher coalesces "
+                    "waiting images across requests up to it")
+    sv.add_argument(
+        "--queue-depth", type=int, default=64,
+        help="max admitted-but-unscored images; beyond it requests get "
+        "429 with a measured Retry-After",
+    )
+    sv.add_argument(
+        "--batch-window-ms", type=float, default=5.0,
+        help="max wait for an under-filled batch to gain company — the "
+        "latency/throughput dial of the cross-request batcher",
+    )
+    sv.add_argument(
+        "--deadline-ms", type=float, default=2000.0,
+        help="per-request deadline: work not scored in time is dropped "
+        "with 503 instead of scored late (0 disables)",
+    )
+    sv.add_argument(
+        "--drain-timeout", type=float, default=10.0,
+        help="graceful-shutdown bound: seconds to finish queued work "
+        "after Ctrl-C before the server closes anyway",
+    )
+    sv.add_argument(
+        "--decode-workers", type=int, default=2,
+        help="JPEG decode threads feeding the batcher (host-side work, "
+        "off the scoring thread)",
+    )
     sv.set_defaults(fn=_cmd_serve)
 
 
 def _cmd_serve(args: argparse.Namespace) -> int:
-    from ..workloads.serving import Predictor, make_server
+    from ..serving import SchedulerConfig
+    from ..workloads.serving import Predictor, serve_in_thread
 
     # Resolve the metadata FIRST (narrowly scoped corrupt-meta
     # diagnosis, same as predict/export); a KeyError from the much
@@ -1455,20 +1484,36 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         # predict/export, no traceback.
         print(e)
         return 1
-    server = make_server(predictor, args.host, args.port)
-    host, port = server.server_address[:2]
+    config = SchedulerConfig(
+        queue_depth=args.queue_depth,
+        batch_window_ms=args.batch_window_ms,
+        deadline_ms=args.deadline_ms,
+        drain_timeout_s=args.drain_timeout,
+        decode_workers=args.decode_workers,
+    )
+    # The accept loop runs in the handle's thread so Ctrl-C lands here,
+    # where close() can drain WHILE the server still answers (/readyz
+    # flips 503, queued work finishes, in-flight responses complete).
+    handle = serve_in_thread(predictor, args.host, args.port, config=config)
     print(json.dumps({
-        "serving": f"http://{host}:{port}",
+        "serving": handle.address,
         "model": predictor.meta.get("model"),
         "checkpoint_step": predictor.step,
         "crop": predictor.crop,
+        "micro_batch": predictor.micro_batch,
+        "queue_depth": config.queue_depth,
+        "batch_window_ms": config.batch_window_ms,
+        "deadline_ms": config.deadline_ms,
     }), flush=True)
     try:
-        server.serve_forever()
+        while handle.thread.is_alive():
+            handle.thread.join(1.0)
     except KeyboardInterrupt:
-        pass
+        print(json.dumps({"draining": True,
+                          "pending_images": handle.scheduler.pending}),
+              flush=True)
     finally:
-        server.server_close()
+        handle.close(args.drain_timeout)
     return 0
 
 

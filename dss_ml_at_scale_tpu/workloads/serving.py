@@ -2,7 +2,8 @@
 
 The reference's deployment story ends at the Databricks platform
 (model serving endpoints); this is the plain-filesystem equivalent: a
-stdlib ``ThreadingHTTPServer`` in front of a compiled scoring function.
+stdlib ``ThreadingHTTPServer`` in front of a compiled scoring function,
+with a serving scheduler (:mod:`..serving`) between them.
 
 Design points (TPU-shaped):
 
@@ -10,19 +11,32 @@ Design points (TPU-shaped):
   micro-batch; requests are padded up to it (and chunked above it), so
   no request shape ever triggers a recompile — the latency profile is
   flat after warmup.
+- **Scheduler-mediated scoring**: HTTP threads never run the scorer.
+  They admit into a bounded queue (429 + ``Retry-After`` when full,
+  503 when a per-request deadline expires waiting), a decode pool
+  turns JPEG bytes into arrays off the scoring thread, and ONE batcher
+  thread coalesces images across requests into the compiled
+  micro-batch shape — concurrent single-image requests share one
+  executable call instead of each padding a batch alone.
 - **Same decode, same normalization**: images go through THE training
   transform spec (``imagenet_transform_spec`` — resize-256 field of
   view, normalization, native decode backend) and the same jitted
   scorer ``dsst predict`` uses (``config/checkpoints.make_scorer``);
   class names come from the label vocabulary persisted WITH the
   checkpoint — predictions match ``dsst predict`` by construction.
-- **Endpoints**: ``GET /healthz`` (model/step/status), ``GET /metrics``
-  (Prometheus text exposition of the process telemetry registry —
-  request-latency histograms, error counters, plus whatever else this
-  process metered), ``POST /predict`` with either a raw JPEG body
-  (``Content-Type: image/jpeg``) or JSON
+- **Endpoints**: ``GET /healthz`` (liveness: model/step/state, 200
+  until the process exits — a draining server is still healthy),
+  ``GET /readyz`` (readiness: 200 only while accepting, 503 during
+  warmup/drain so balancers rotate the instance out first),
+  ``GET /metrics`` (Prometheus text exposition of the process
+  telemetry registry — request/queue/batch-fill series and whatever
+  else this process metered), ``POST /predict`` with either a raw JPEG
+  body (``Content-Type: image/jpeg``) or JSON
   ``{"instances": ["<base64 jpeg>", ...]}`` → JSON
   ``{"predictions": [{"pred_index", "pred_prob", "pred_label"}, ...]}``.
+- **Keep-alive**: handlers speak HTTP/1.1 with exact ``Content-Length``
+  on every response, so clients reuse connections instead of paying TCP
+  setup per request under load.
 """
 
 from __future__ import annotations
@@ -34,10 +48,26 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import telemetry
+from ..serving import (
+    DeadlineExceeded,
+    Lifecycle,
+    NotAccepting,
+    QueueFull,
+    SchedulerConfig,
+    ServerHandle,
+    ServingScheduler,
+)
 
 
 class Predictor:
-    """Checkpoint → compiled fixed-batch scorer."""
+    """Checkpoint → compiled fixed-batch scorer.
+
+    The scoring pipeline is split where the scheduler needs it split:
+    :meth:`decode` (host-side JPEG → normalized array, safe to run from
+    many decode workers) and :meth:`score` (pad/chunk to the compiled
+    shape, one executable call per chunk — the batcher thread's half).
+    :meth:`predict` composes the two for synchronous embedding use.
+    """
 
     def __init__(self, checkpoint_dir: str, *, step: int | None = None,
                  micro_batch: int = 8):
@@ -78,18 +108,18 @@ class Predictor:
         self._score = make_scorer(task, variables)
         self._jnp = jnp
         self._np = np
-        # Scoring-path telemetry: latency per predict() call (decode +
-        # score + host fetch), images scored, and failures. Handles are
-        # resolved once here, not per request.
+        # Scoring-path telemetry: latency per score() call, images
+        # scored, and failures. Handles are resolved once here, not per
+        # request.
         self._predict_hist = telemetry.histogram(
             "predict_batch_seconds",
-            "Predictor.predict latency (decode + score + fetch)",
+            "Predictor.score latency (pad + score + host fetch)",
         )
         self._predict_images = telemetry.counter(
-            "predict_images_total", "images scored by Predictor.predict"
+            "predict_images_total", "images scored by Predictor.score"
         )
         self._predict_errors = telemetry.counter(
-            "predict_errors_total", "Predictor.predict calls that raised"
+            "predict_errors_total", "Predictor.score calls that raised"
         )
         # Warm the one executable so the first request pays no compile.
         self._score(
@@ -97,27 +127,43 @@ class Predictor:
                       jnp.float32)
         )
 
-    def predict(self, jpegs: list[bytes]) -> list[dict]:
-        """Decoded, padded, chunked scoring of a request's images."""
-        t0 = time.perf_counter()
-        try:
-            out = self._predict(jpegs)
-        except BaseException:
-            self._predict_errors.inc()
-            raise
-        self._predict_hist.observe(time.perf_counter() - t0)
-        self._predict_images.inc(len(jpegs))
-        return out
+    def decode(self, jpegs: list[bytes]):
+        """JPEG bytes → normalized image array (N, crop, crop, 3).
 
-    def _predict(self, jpegs: list[bytes]) -> list[dict]:
-        np, jnp = self._np, self._jnp
+        Pure host work (libjpeg + resize + normalize) — the half the
+        scheduler's decode pool runs concurrently, off the scorer.
+        """
+        np = self._np
         content = np.empty(len(jpegs), object)
         content[:] = jpegs
         cols = self._spec({
             "content": content,
             "label_index": np.zeros(len(jpegs), np.int64),
         })
-        images = cols["image"]
+        return cols["image"]
+
+    def score(self, images) -> list[dict]:
+        """Decoded images → prediction rows via the compiled executable.
+
+        Pads the tail chunk to the compiled ``micro_batch`` shape (and
+        chunks above it), so no input size ever triggers a recompile.
+        """
+        t0 = time.perf_counter()
+        try:
+            out = self._score_images(images)
+        except BaseException:
+            self._predict_errors.inc()
+            raise
+        self._predict_hist.observe(time.perf_counter() - t0)
+        self._predict_images.inc(len(images))
+        return out
+
+    def predict(self, jpegs: list[bytes]) -> list[dict]:
+        """Synchronous decode + score of one request's images."""
+        return self.score(self.decode(jpegs))
+
+    def _score_images(self, images) -> list[dict]:
+        np, jnp = self._np, self._jnp
         out: list[dict] = []
         for lo in range(0, len(images), self.micro_batch):
             chunk = images[lo:lo + self.micro_batch]
@@ -141,11 +187,17 @@ class Predictor:
         return out
 
 
-def make_server(predictor: Predictor, host: str = "127.0.0.1",
+def make_server(predictor, host: str = "127.0.0.1",
                 port: int = 8008, *,
                 max_body_bytes: int = 64 * 1024 * 1024,
-                max_instances: int = 1024) -> ThreadingHTTPServer:
+                max_instances: int = 1024,
+                config: SchedulerConfig | None = None) -> ThreadingHTTPServer:
     """A ready-to-run server (caller picks ``serve_forever`` vs thread).
+
+    The returned server owns a started :class:`ServingScheduler`
+    (``server.scheduler``) and its :class:`Lifecycle`
+    (``server.lifecycle``), already marked READY — callers drive the
+    drain through them (or use :func:`serve_in_thread`'s handle).
 
     ``max_body_bytes`` / ``max_instances`` bound what one request can
     make the server materialize (413 above the caps): without them a
@@ -164,9 +216,21 @@ def make_server(predictor: Predictor, host: str = "127.0.0.1",
         "serving_errors_total", "HTTP 4xx/5xx responses", labels=("code",)
     )
 
-    _known_paths = frozenset(("/healthz", "/metrics", "/predict"))
+    lifecycle = Lifecycle()
+    scheduler = ServingScheduler(predictor, config, lifecycle=lifecycle)
+
+    _known_paths = frozenset(("/healthz", "/readyz", "/metrics", "/predict"))
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 with exact Content-Length everywhere → keep-alive:
+        # clients reuse the connection instead of paying TCP setup per
+        # request under load.
+        protocol_version = "HTTP/1.1"
+        # Keep-alive's tax: an idle connection parks a handler thread in
+        # readline(). The socket timeout reaps it; without this a quiet
+        # client would pin a thread forever.
+        timeout = 60
+
         def log_message(self, *a):  # quiet by default; errors still raise
             pass
 
@@ -176,13 +240,15 @@ def make_server(predictor: Predictor, host: str = "127.0.0.1",
             path = self.path if self.path in _known_paths else "other"
             request_hist.labels(path=path).observe(time.perf_counter() - t0)
 
-        def _json(self, code: int, payload: dict) -> None:
+        def _json(self, code: int, payload: dict, headers=None) -> None:
             if code >= 400:
                 error_counter.labels(code=str(code)).inc()
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
 
@@ -200,12 +266,24 @@ def make_server(predictor: Predictor, host: str = "127.0.0.1",
             t0 = time.perf_counter()
             try:
                 if self.path == "/healthz":
+                    # Liveness: 200 even while draining — a draining
+                    # server is healthy; restarting it would kill the
+                    # work the drain protects.
                     self._json(200, {
                         "status": "ok",
+                        "state": lifecycle.state,
                         "model": predictor.meta.get("model"),
                         "checkpoint_step": predictor.step,
                         "crop": predictor.crop,
                     })
+                elif self.path == "/readyz":
+                    # Readiness: only READY takes traffic.
+                    if lifecycle.accepting:
+                        self._json(200, {"ready": True,
+                                         "state": lifecycle.state})
+                    else:
+                        self._json(503, {"ready": False,
+                                         "state": lifecycle.state})
                 elif self.path == "/metrics":
                     self._metrics()
                 else:
@@ -226,22 +304,30 @@ def make_server(predictor: Predictor, host: str = "127.0.0.1",
             if self.path != "/predict":
                 self._json(404, {"error": f"no route {self.path}"})
                 return
+            # Responding WITHOUT consuming the body would leave its
+            # bytes in the keep-alive stream, desyncing the next
+            # request on this connection — these early returns must
+            # advertise and perform a close (send_header("Connection",
+            # "close") also sets close_connection).
+            _close = {"Connection": "close"}
             try:
                 length = int(self.headers.get("Content-Length", 0))
             except ValueError:
-                self._json(400, {"error": "bad Content-Length"})
+                self._json(400, {"error": "bad Content-Length"},
+                           headers=_close)
                 return
             if length < 0:
                 # A negative length would make rfile.read() read until
                 # EOF — exactly the unbounded read the cap exists to
                 # prevent.
-                self._json(400, {"error": "bad Content-Length"})
+                self._json(400, {"error": "bad Content-Length"},
+                           headers=_close)
                 return
             if length > max_body_bytes:
                 self._json(413, {
                     "error": f"body {length} bytes exceeds limit "
                              f"{max_body_bytes}",
-                })
+                }, headers=_close)
                 return
             body = self.rfile.read(length)
             try:
@@ -263,7 +349,17 @@ def make_server(predictor: Predictor, host: str = "127.0.0.1",
                     jpegs = [body]  # raw single JPEG
                 if not jpegs:
                     raise ValueError("empty instances")
-                preds = predictor.predict(jpegs)
+                preds = scheduler.submit(jpegs)
+            except QueueFull as e:
+                # Backpressure, not failure: the client should retry
+                # after the queue's measured time-to-capacity.
+                self._json(429, {"error": str(e)},
+                           headers={"Retry-After": str(e.retry_after)})
+                return
+            except (DeadlineExceeded, NotAccepting) as e:
+                # Too late (deadline) or going away (drain): shed, 503.
+                self._json(503, {"error": str(e)})
+                return
             except (json.JSONDecodeError, KeyError, TypeError, ValueError,
                     OSError) as e:
                 # Input-shaped failures (bad JSON, missing keys, broken
@@ -277,15 +373,32 @@ def make_server(predictor: Predictor, host: str = "127.0.0.1",
                 return
             self._json(200, {"predictions": preds})
 
-    return ThreadingHTTPServer((host, port), Handler)
+    server = _ServingHTTPServer((host, port), Handler)
+    server.scheduler = scheduler
+    server.lifecycle = lifecycle
+    scheduler.start()
+    lifecycle.mark_ready()
+    return server
 
 
-def serve_in_thread(predictor: Predictor, host: str = "127.0.0.1",
-                    port: int = 0):
-    """(server, thread) with the server already running — the test and
+class _ServingHTTPServer(ThreadingHTTPServer):
+    # Keep-alive holds one handler thread per open client connection;
+    # joining them on server_close (the ThreadingMixIn default) would
+    # block shutdown on whichever client forgot to hang up. Daemon
+    # threads: close() returns once the drain settled the WORK — the
+    # response bytes flush from threads that die with the process.
+    daemon_threads = True
+
+
+def serve_in_thread(predictor, host: str = "127.0.0.1", port: int = 0, *,
+                    config: SchedulerConfig | None = None) -> ServerHandle:
+    """A running server as a :class:`ServerHandle` — the test and
     embedding entry point; ``port=0`` picks a free port
-    (``server.server_address[1]``)."""
-    server = make_server(predictor, host, port)
+    (``handle.port``). ``handle.close()`` performs the graceful drain
+    (stop admitting → finish queued work → stop the accept loop → close
+    the socket), so embedders never leak the server socket or kill
+    in-flight requests mid-write."""
+    server = make_server(predictor, host, port, config=config)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
-    return server, thread
+    return ServerHandle(server, thread)

@@ -58,10 +58,9 @@ def server(trained_ckpt):
 
     ckpt, jpegs = trained_ckpt
     predictor = Predictor(str(ckpt), micro_batch=4)
-    srv, _thread = serve_in_thread(predictor)
-    yield srv.server_address[1], jpegs
-    srv.shutdown()
-    srv.server_close()
+    handle = serve_in_thread(predictor)
+    yield handle.port, jpegs
+    handle.close()
 
 
 def _request(port, method, path, body=None, content_type=None):
@@ -79,8 +78,16 @@ def test_healthz(server):
     status, payload = _request(port, "GET", "/healthz")
     assert status == 200
     assert payload["status"] == "ok"
+    assert payload["state"] == "ready"
     assert payload["model"] == "tiny"
     assert payload["crop"] == 64
+
+
+def test_readyz_while_serving(server):
+    port, _ = server
+    status, payload = _request(port, "GET", "/readyz")
+    assert status == 200
+    assert payload["ready"] is True
 
 
 def test_predict_raw_jpeg(server):
@@ -169,6 +176,12 @@ def test_metrics_scrape_includes_predictor_series(server):
     assert "# TYPE predict_batch_seconds histogram" in text
     assert "predict_batch_seconds_count" in text
     assert "predict_images_total" in text
+    # The scheduler's series render on the same scrape: this request
+    # rode a scored batch, and the gauge/queue families declare.
+    assert "# TYPE serving_batch_fill histogram" in text
+    assert "serving_batch_fill_count" in text
+    assert "# TYPE serving_queue_depth gauge" in text
+    assert "# TYPE serving_time_in_queue_seconds histogram" in text
 
 
 def test_serving_matches_dsst_predict(server, trained_ckpt, tmp_path):
@@ -231,9 +244,9 @@ def test_serving_vit_checkpoint(tmp_path, devices8):
     ]) == 0
 
     predictor = Predictor(str(ckpt), micro_batch=4)
-    srv, _t = serve_in_thread(predictor)
+    handle = serve_in_thread(predictor)
     try:
-        port = srv.server_address[1]
+        port = handle.port
         status, payload = _request(
             port, "POST", "/predict", body=jpegs[0],
             content_type="image/jpeg",
@@ -243,5 +256,4 @@ def test_serving_vit_checkpoint(tmp_path, devices8):
         status, health = _request(port, "GET", "/healthz")
         assert health["model"] == "vit-tiny"
     finally:
-        srv.shutdown()
-        srv.server_close()
+        handle.close()

@@ -1,0 +1,468 @@
+"""Serving scheduler behaviors (dss_ml_at_scale_tpu/serving/).
+
+Driven through the REAL HTTP layer with a Predictor-shaped stub (no
+checkpoint, no compile) so the scheduler contract — cross-request
+coalescing, 429 backpressure with Retry-After, deadline 503 without
+late scoring, readyz/healthz split, graceful drain — runs in
+milliseconds. The checkpoint-backed end-to-end path lives in
+test_serving.py.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dss_ml_at_scale_tpu import telemetry
+from dss_ml_at_scale_tpu.serving import (
+    AdmissionController,
+    NotAccepting,
+    QueueFull,
+    SchedulerConfig,
+    ServingScheduler,
+)
+from dss_ml_at_scale_tpu.workloads.serving import serve_in_thread
+
+
+class _Scorer:
+    """Predictor-shaped stub: decode parses the payload's integer,
+    score echoes it back as pred_index — so tests can assert that
+    per-request result mapping survives cross-request batching."""
+
+    meta = {"model": "stub"}
+    step = 0
+    crop = 4
+
+    def __init__(self, micro_batch=8, score_delay_s=0.0):
+        self.micro_batch = micro_batch
+        self.score_delay_s = score_delay_s
+        self.batches = []  # size of every scored batch, in order
+        self._lock = threading.Lock()
+
+    def decode(self, jpegs):
+        return np.array([[float(int(j))] for j in jpegs])
+
+    def score(self, images):
+        if self.score_delay_s:
+            time.sleep(self.score_delay_s)
+        with self._lock:
+            self.batches.append(len(images))
+        return [
+            {"pred_index": int(v[0]), "pred_prob": 1.0} for v in images
+        ]
+
+    @property
+    def images_scored(self):
+        with self._lock:
+            return sum(self.batches)
+
+
+def _post(port, body, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/predict", body=body,
+                 headers={"Content-Type": "image/jpeg"})
+    resp = conn.getresponse()
+    payload = json.loads(resp.read())
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, payload, headers
+
+
+def _get(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    payload = json.loads(resp.read())
+    conn.close()
+    return resp.status, payload
+
+
+def _metric(name, labels=None):
+    """One series' sample from the process registry snapshot."""
+    for m in telemetry.snapshot()["metrics"]:
+        if m["name"] == name and (labels is None or m["labels"] == labels):
+            return m
+    return None
+
+
+def _hist_stats(name):
+    m = _metric(name)
+    return (m["count"], m["sum"]) if m else (0, 0.0)
+
+
+def _counter_value(name):
+    m = _metric(name)
+    return m["value"] if m else 0.0
+
+
+# ---------------------------------------------------------------------------
+# coalescing
+# ---------------------------------------------------------------------------
+
+def test_concurrent_singles_coalesce_into_micro_batches():
+    """The acceptance scenario: 16 concurrent single-image clients
+    against a micro-batch-8 scorer share executable calls — mean batch
+    fill > 4, vs exactly 1 for per-request scoring."""
+    stub = _Scorer(micro_batch=8, score_delay_s=0.05)
+    handle = serve_in_thread(stub, config=SchedulerConfig(
+        queue_depth=64, batch_window_ms=250.0,
+    ))
+    fill_count0, fill_sum0 = _hist_stats("serving_batch_fill")
+    n_clients = 16
+    barrier = threading.Barrier(n_clients)
+    results = {}
+
+    def client(i):
+        barrier.wait()
+        results[i] = _post(handle.port, str(i).encode())
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert len(results) == n_clients
+        for i, (status, payload, _) in results.items():
+            assert status == 200
+            # Fan-out integrity: each client got ITS row back even
+            # though it was scored inside a shared batch.
+            assert payload["predictions"][0]["pred_index"] == i
+        assert stub.images_scored == n_clients
+        mean_fill = stub.images_scored / len(stub.batches)
+        assert mean_fill > 4, f"batches {stub.batches}"
+        # The same fact via the batch-fill histogram (what dashboards
+        # — and the loadgen — read).
+        fill_count, fill_sum = _hist_stats("serving_batch_fill")
+        d_count, d_sum = fill_count - fill_count0, fill_sum - fill_sum0
+        assert d_count == len(stub.batches)
+        assert d_sum / d_count > 4
+    finally:
+        handle.close()
+
+
+def test_single_request_pays_at_most_the_window():
+    """A lone request isn't held hostage for a full batch: it scores
+    after the window elapses, alone."""
+    stub = _Scorer(micro_batch=8)
+    handle = serve_in_thread(stub, config=SchedulerConfig(
+        batch_window_ms=20.0,
+    ))
+    try:
+        t0 = time.monotonic()
+        status, payload, _ = _post(handle.port, b"3")
+        elapsed = time.monotonic() - t0
+        assert status == 200
+        assert payload["predictions"][0]["pred_index"] == 3
+        assert stub.batches == [1]
+        assert elapsed < 5.0  # window + overhead, nowhere near a hang
+    finally:
+        handle.close()
+
+
+def test_multi_image_request_through_the_scheduler():
+    """A JSON batch request flows through the same pipeline and keeps
+    its row order."""
+    import base64
+
+    stub = _Scorer(micro_batch=4)
+    handle = serve_in_thread(stub, config=SchedulerConfig(
+        batch_window_ms=10.0,
+    ))
+    try:
+        body = json.dumps({"instances": [
+            base64.b64encode(str(i).encode()).decode() for i in (5, 9, 2)
+        ]})
+        conn = http.client.HTTPConnection("127.0.0.1", handle.port,
+                                          timeout=30)
+        conn.request("POST", "/predict", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        payload = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert [p["pred_index"] for p in payload["predictions"]] == [5, 9, 2]
+    finally:
+        handle.close()
+
+
+def test_bad_payload_is_400_not_fatal():
+    """A decode failure inside the pool surfaces as the client's 400,
+    and the pipeline keeps serving."""
+    stub = _Scorer(micro_batch=4)
+    handle = serve_in_thread(stub, config=SchedulerConfig(
+        batch_window_ms=5.0,
+    ))
+    try:
+        status, payload, _ = _post(handle.port, b"not-an-int")
+        assert status == 400 and "error" in payload
+        status, payload, _ = _post(handle.port, b"11")
+        assert status == 200
+        assert payload["predictions"][0]["pred_index"] == 11
+    finally:
+        handle.close()
+
+
+def test_request_wider_than_queue_is_permanent_400():
+    """A request that could NEVER be admitted must not get a 429 (a
+    retrying client would loop forever) — it's the client's 400."""
+    import base64
+
+    stub = _Scorer(micro_batch=4)
+    handle = serve_in_thread(stub, config=SchedulerConfig(
+        queue_depth=4, batch_window_ms=1.0,
+    ))
+    try:
+        body = json.dumps({"instances": [
+            base64.b64encode(str(i).encode()).decode() for i in range(5)
+        ]})
+        conn = http.client.HTTPConnection("127.0.0.1", handle.port,
+                                          timeout=30)
+        conn.request("POST", "/predict", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        payload = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 400
+        assert "queue depth" in payload["error"]
+    finally:
+        handle.close()
+
+
+def test_oversized_body_413_closes_the_keepalive_connection():
+    """An early-return 413 never read the body; leaving the connection
+    open would desync the next keep-alive request against the unread
+    bytes — the server must close instead."""
+    import threading as _threading
+
+    from dss_ml_at_scale_tpu.serving import ServerHandle
+    from dss_ml_at_scale_tpu.workloads.serving import make_server
+
+    server = make_server(_Scorer(), port=0, max_body_bytes=16,
+                         config=SchedulerConfig(batch_window_ms=1.0))
+    thread = _threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    handle = ServerHandle(server, thread)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", handle.port,
+                                          timeout=30)
+        conn.request("POST", "/predict", body=b"x" * 64,
+                     headers={"Content-Type": "image/jpeg"})
+        resp = conn.getresponse()
+        payload = json.loads(resp.read())
+        assert resp.status == 413 and "exceeds" in payload["error"]
+        assert resp.getheader("Connection", "").lower() == "close"
+        conn.close()
+        # And the server still answers fresh connections.
+        status, payload, _ = _post(handle.port, b"4")
+        assert status == 200
+        assert payload["predictions"][0]["pred_index"] == 4
+    finally:
+        handle.close()
+
+
+# ---------------------------------------------------------------------------
+# backpressure
+# ---------------------------------------------------------------------------
+
+def test_full_queue_returns_429_with_retry_after():
+    stub = _Scorer(micro_batch=1, score_delay_s=0.2)
+    handle = serve_in_thread(stub, config=SchedulerConfig(
+        queue_depth=2, batch_window_ms=1.0, decode_workers=1,
+    ))
+    rejected0 = _counter_value("serving_admission_rejected_total")
+    n_clients = 10
+    barrier = threading.Barrier(n_clients)
+    results = {}
+
+    def client(i):
+        barrier.wait()
+        results[i] = _post(handle.port, str(i).encode())
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        statuses = [results[i][0] for i in range(n_clients)]
+        assert 429 in statuses, statuses
+        assert statuses.count(200) >= 1
+        assert set(statuses) <= {200, 429}
+        for i in range(n_clients):
+            status, payload, headers = results[i]
+            if status == 429:
+                # The backpressure contract: a machine-readable hint of
+                # when capacity frees up.
+                assert int(headers["Retry-After"]) >= 1
+                assert "full" in payload["error"]
+        rejected = _counter_value("serving_admission_rejected_total")
+        assert rejected - rejected0 == statuses.count(429)
+
+        # Backpressure is transient: once the queue drains, the same
+        # server admits again.
+        for _ in range(100):
+            status, payload, _ = _post(handle.port, b"7")
+            if status == 200:
+                break
+            time.sleep(0.05)
+        assert status == 200
+        assert payload["predictions"][0]["pred_index"] == 7
+    finally:
+        handle.close()
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_expired_is_503_and_never_scored():
+    """A request whose deadline passes while waiting is answered 503
+    at the deadline (not after the scorer frees up), and its image is
+    dropped — the compiled scorer never runs for it."""
+    stub = _Scorer(micro_batch=1, score_delay_s=0.4)
+    handle = serve_in_thread(stub, config=SchedulerConfig(
+        queue_depth=64, batch_window_ms=1.0, deadline_ms=120.0,
+        decode_workers=1,
+    ))
+    expired0 = _counter_value("serving_deadline_expired_total")
+    first = {}
+
+    def occupant():
+        # Occupies the scorer for 400 ms; its own 120 ms deadline fires
+        # mid-score, so IT gets the late-work 503 as well.
+        first["r"] = _post(handle.port, b"1")
+
+    t = threading.Thread(target=occupant)
+    try:
+        t.start()
+        time.sleep(0.1)  # occupant admitted and scoring
+        t0 = time.monotonic()
+        status, payload, _ = _post(handle.port, b"2")
+        elapsed = time.monotonic() - t0
+        assert status == 503
+        assert "deadline" in payload["error"]
+        # Answered at the deadline, not after the 400 ms score.
+        assert elapsed < 0.35, elapsed
+        t.join(10)
+        assert first["r"][0] == 503  # scored late -> still a 503
+    finally:
+        handle.close()
+    # close() drained: the skipped item has been retired by now. Only
+    # the occupant's image ever reached the scorer.
+    assert stub.images_scored == 1, stub.batches
+    expired = _counter_value("serving_deadline_expired_total")
+    assert expired - expired0 == 2
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: readyz/healthz split + graceful drain
+# ---------------------------------------------------------------------------
+
+def test_graceful_drain_finishes_queued_work_then_closes():
+    stub = _Scorer(micro_batch=2, score_delay_s=0.3)
+    handle = serve_in_thread(stub, config=SchedulerConfig(
+        queue_depth=64, batch_window_ms=1.0,
+    ))
+    port = handle.port
+
+    status, payload = _get(port, "/readyz")
+    assert status == 200 and payload["ready"] is True
+    status, payload = _get(port, "/healthz")
+    assert status == 200 and payload["state"] == "ready"
+
+    slow = {}
+
+    def client():
+        slow["r"] = _post(port, b"5")
+
+    t = threading.Thread(target=client)
+    t.start()
+    time.sleep(0.05)  # admitted and scoring
+
+    closer = threading.Thread(target=handle.close)
+    closer.start()
+    time.sleep(0.05)  # drain started, server still answering
+
+    # Readiness flips immediately; liveness stays up (a draining server
+    # is healthy — restarting it would kill the drain-protected work).
+    status, payload = _get(port, "/readyz")
+    assert status == 503 and payload["ready"] is False
+    assert payload["state"] == "draining"
+    status, payload = _get(port, "/healthz")
+    assert status == 200 and payload["state"] == "draining"
+
+    # New work is shed with 503 while the drain runs...
+    status, payload, _ = _post(port, b"9")
+    assert status == 503
+    assert "not accepting" in payload["error"]
+
+    closer.join(15)
+    t.join(15)
+    # ... but the admitted request finished scoring and got its 200.
+    assert slow["r"][0] == 200
+    assert slow["r"][1]["predictions"][0]["pred_index"] == 5
+
+    # After close the socket is really gone.
+    with pytest.raises(OSError):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+        conn.request("GET", "/healthz")
+        conn.getresponse()
+
+    # close() is idempotent.
+    handle.close()
+
+
+# ---------------------------------------------------------------------------
+# library-level API (no HTTP)
+# ---------------------------------------------------------------------------
+
+def test_scheduler_direct_submit_and_stop():
+    stub = _Scorer(micro_batch=4)
+    sched = ServingScheduler(stub, SchedulerConfig(
+        queue_depth=8, batch_window_ms=5.0,
+    )).start()
+    sched.lifecycle.mark_ready()
+    try:
+        rows = sched.submit([b"3", b"7"])
+        assert [r["pred_index"] for r in rows] == [3, 7]
+        with pytest.raises(ValueError):
+            sched.submit([])
+        with pytest.raises(ValueError):
+            sched.submit([b"1"] * 9)  # wider than the whole queue
+    finally:
+        sched.stop()
+    assert sched.pending == 0
+    with pytest.raises(NotAccepting):
+        sched.submit([b"1"])
+
+
+def test_scheduler_not_ready_until_marked():
+    stub = _Scorer()
+    sched = ServingScheduler(stub, SchedulerConfig()).start()
+    try:
+        with pytest.raises(NotAccepting):
+            sched.submit([b"1"])  # lifecycle still STARTING
+    finally:
+        sched.stop()
+
+
+def test_admission_controller_bounds_and_retry_after():
+    ac = AdmissionController(2)
+    ac.admit(2)
+    with pytest.raises(QueueFull) as exc_info:
+        ac.admit(1)
+    assert exc_info.value.retry_after >= 1
+    assert ac.pending == 2
+    ac.release(2)
+    ac.admit(1)  # slots actually freed
+    assert ac.pending == 1
+    # All-or-nothing: a 2-image request over a 1-slot remainder refuses
+    # whole.
+    with pytest.raises(QueueFull):
+        ac.admit(2)

@@ -312,10 +312,9 @@ class _StubPredictor:
 def stub_server():
     from dss_ml_at_scale_tpu.workloads.serving import serve_in_thread
 
-    server, _thread = serve_in_thread(_StubPredictor())
-    yield server.server_address[1]
-    server.shutdown()
-    server.server_close()
+    handle = serve_in_thread(_StubPredictor())
+    yield handle.port
+    handle.close()
 
 
 def _request(port, method, path, body=None, content_type=None):
