@@ -23,6 +23,14 @@ def build_parser() -> argparse.ArgumentParser:
         "on some hosts, so this applies the in-process config update "
         "that actually sticks",
     )
+    parser.add_argument(
+        "--fault-plan", default=None, metavar="SPEC",
+        help="arm deterministic fault injection for this invocation, e.g. "
+        "'rpc.send=2;reader.next=p0.1;seed=7' (sites: rpc.send, "
+        "trial.evaluate, checkpoint.save, checkpoint.restore, "
+        "reader.next; N = fail the first N hits, pX = seeded per-hit "
+        "probability). Default: env DSST_FAULT_PLAN; chaos testing only",
+    )
     sub = parser.add_subparsers(dest="command")
     info = sub.add_parser("info", help="show runtime topology and devices")
     info.add_argument(
@@ -84,8 +92,20 @@ def _cmd_info(args: argparse.Namespace) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import os
+
     parser = build_parser()
     args = parser.parse_args(argv)
+    fault_spec = args.fault_plan or os.environ.get("DSST_FAULT_PLAN")
+    if fault_spec:
+        # Armed before any subcommand work, and exported so subprocess
+        # workers (which inherit the env and re-enter main here) arm the
+        # same plan — a --fault-plan chaos run must not silently test
+        # only the driver process.
+        os.environ["DSST_FAULT_PLAN"] = fault_spec
+        from ..resilience.faults import install_from_spec
+
+        install_from_spec(fault_spec)
     if args.platform:
         import jax
 

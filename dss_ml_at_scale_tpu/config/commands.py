@@ -664,6 +664,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 "best_checkpoint": result.best_checkpoint_path,
                 "decode_backend": spec.backend,
                 "decode_substitutions": spec.substitutions.count,
+                # True when a SIGTERM (spot/TPU-VM eviction) cut the run
+                # short; rerun with --resume to continue from the saved step.
+                "preempted": result.preempted,
             }
         )
     )
@@ -1085,6 +1088,11 @@ def register_hpo(sub: argparse._SubParsersAction) -> None:
         help="file holding the shared RPC secret (or env DSST_RPC_SECRET); "
         "enables the HMAC handshake with the workers",
     )
+    hp_.add_argument(
+        "--max-retries", type=int, default=2,
+        help="(--workers mode) transport-failure requeues per trial before "
+        "it fails; objective exceptions are never retried",
+    )
     _add_tracking_args(hp_, "hpo")
     hp_.set_defaults(fn=_cmd_hpo)
 
@@ -1167,6 +1175,7 @@ def _cmd_hpo(args: argparse.Namespace) -> int:
             args.workers.split(","),
             parallelism=args.parallelism,
             secret=_rpc_secret(args),
+            max_retries=args.max_retries,
         )
         best = fmin(
             "dss_ml_at_scale_tpu.hpo.objectives:lasso_shared",
@@ -1517,6 +1526,54 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+def register_checkpoints(sub: argparse._SubParsersAction) -> None:
+    ck = sub.add_parser(
+        "checkpoints",
+        help="checkpoint maintenance: verify per-step integrity manifests",
+    )
+    csub = ck.add_subparsers(dest="checkpoints_cmd", required=True)
+    vf = csub.add_parser(
+        "verify",
+        help="walk a checkpoint dir's steps and report intact / corrupt / "
+        "unverified per the dsst_manifest.json content checksums — the "
+        "operator-facing face of the restore-fallback integrity layer",
+    )
+    vf.add_argument("dir", help="a dsst train/lm checkpoint directory")
+    vf.add_argument(
+        "--json", action="store_true",
+        help="emit the full report as one JSON document instead of lines",
+    )
+    vf.set_defaults(fn=_cmd_checkpoints_verify)
+
+
+def _cmd_checkpoints_verify(args: argparse.Namespace) -> int:
+    from ..resilience import verify_checkpoint_dir
+
+    if not Path(args.dir).is_dir():
+        print(f"no such checkpoint directory: {args.dir}")
+        return 2
+    report = verify_checkpoint_dir(args.dir)
+    counts = {"intact": 0, "corrupt": 0, "unverified": 0}
+    for entry in report:
+        counts[entry["status"]] += 1
+    if args.json:
+        print(json.dumps({"dir": args.dir, "steps": report, **counts}))
+    else:
+        if not report:
+            print(f"no checkpoint steps under {args.dir}")
+        for entry in report:
+            line = f"step {entry['step']}: {entry['status']}"
+            if entry["problems"]:
+                line += " (" + "; ".join(entry["problems"]) + ")"
+            print(line)
+        if report:
+            print(
+                f"{counts['intact']} intact, {counts['corrupt']} corrupt, "
+                f"{counts['unverified']} unverified (no manifest)"
+            )
+    return 1 if counts["corrupt"] else 0
+
+
 def register_runs(sub: argparse._SubParsersAction) -> None:
     rn = sub.add_parser(
         "runs",
@@ -1685,6 +1742,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_lm(sub)
     register_hpo(sub)
     register_trial_worker(sub)
+    register_checkpoints(sub)
     register_runs(sub)
     register_telemetry(sub)
     from .pipeline import register_pipeline

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import logging
 import queue
 import threading
 import time
@@ -41,10 +42,18 @@ from typing import Iterator, Sequence
 import numpy as np
 import pyarrow.parquet as pq
 
+from ..resilience.faults import maybe_fail
+from ..resilience.retry import RetryPolicy, call_with_retry
 from .sharding import RowGroupUnit, list_row_groups, shard_units
 from .transform import TransformSpec
 
+log = logging.getLogger(__name__)
+
 _SENTINEL = object()
+
+# Transient-read retry shape: two quick retries cover an NFS/object-store
+# blip without meaningfully delaying a genuinely failed epoch.
+_READ_RETRY = RetryPolicy(max_retries=2, base_delay=0.05, max_delay=0.5)
 
 
 class _WorkerError:
@@ -138,6 +147,10 @@ class ParquetShardReader:
             )
 
     def _load_unit(self, unit: RowGroupUnit) -> dict[str, np.ndarray]:
+        # Fault-injection site: a transient failure here (or a real NFS
+        # blip / truncated read below) is retried by the worker before it
+        # gives up and fails the epoch — see _load_unit_with_retry.
+        maybe_fail("reader.next")
         # One ParquetFile handle per (worker thread, path): footers parse
         # once per worker instead of once per row group, and handles are
         # never shared across threads (ParquetFile reads aren't
@@ -154,6 +167,30 @@ class ParquetShardReader:
         if self.transform_spec is not None:
             cols = self.transform_spec(cols)
         return cols
+
+    def _load_unit_with_retry(self, unit: RowGroupUnit) -> dict[str, np.ndarray]:
+        # A flaky filesystem read should cost a short backoff, not the
+        # whole epoch; semantic decode errors (bad bytes, schema
+        # mismatch) are deterministic and fail immediately.
+        def evict_handle(attempt, exc, delay) -> None:
+            # The cached ParquetFile holds an open fd + parsed footer; a
+            # stale NFS handle or truncated read poisons it, and retrying
+            # through the same handle would just replay the failure.
+            # Close it too — dropping the reference alone leaks the fd
+            # until GC.
+            stale = self._local.__dict__.setdefault("files", {}).pop(
+                unit.path, None
+            )
+            if stale is not None:
+                try:
+                    stale.close()
+                except Exception as close_exc:
+                    log.debug("closing evicted reader handle: %r", close_exc)
+
+        return call_with_retry(
+            self._load_unit, unit, policy=_READ_RETRY, site="reader.next",
+            on_retry=evict_handle,
+        )
 
     # -- thread pool ------------------------------------------------------
 
@@ -172,7 +209,7 @@ class ParquetShardReader:
                     unit = next(work, _SENTINEL)
                 if unit is _SENTINEL:
                     break
-                _put(self._load_unit(unit))
+                _put(self._load_unit_with_retry(unit))
         except BaseException as e:  # propagate to the consumer, don't die silently
             _put(_WorkerError(e))
         finally:
@@ -184,7 +221,7 @@ class ParquetShardReader:
             for unit in self._unit_stream():
                 if self._stop.is_set():
                     return
-                yield self._load_unit(unit)
+                yield self._load_unit_with_retry(unit)
             return
 
         self._results = results = queue.Queue(maxsize=self.results_queue_size)

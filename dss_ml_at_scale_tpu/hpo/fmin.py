@@ -97,6 +97,12 @@ def call_with_protocol(objective, args) -> dict:
     receive already-evaluated args over the wire).
     """
     try:
+        # Objective-side fault site: an injected fault here exercises the
+        # permanent-fail path (objective failures are deterministic and
+        # must NOT be transport-retried — contrast site rpc.send).
+        from ..resilience.faults import maybe_fail
+
+        maybe_fail("trial.evaluate")
         out = objective(args)
         if isinstance(out, Mapping):
             result = dict(out)

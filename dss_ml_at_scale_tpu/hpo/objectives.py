@@ -17,6 +17,19 @@ def quadratic(args) -> float:
     return (args["x"] - 3.0) ** 2
 
 
+def paced_quadratic(args) -> float:
+    """Quadratic with a small per-trial sleep (``args['delay']``).
+
+    Chaos tests need a sweep that stays in flight long enough for
+    mid-sweep events — a worker dying and coming back, a heartbeat
+    re-admission — to land while trials are still being proposed.
+    """
+    import time
+
+    time.sleep(float(args.get("delay", 0.05)))
+    return quadratic(args)
+
+
 def brittle_quadratic(args) -> float:
     """Quadratic that raises on half its domain — failure-isolation probe."""
     if args["x"] < 0:

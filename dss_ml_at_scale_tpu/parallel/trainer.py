@@ -25,6 +25,8 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import logging
+import math
+import threading
 import time
 from pathlib import Path
 from typing import Any, Callable, Iterable, Mapping
@@ -38,6 +40,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry
 from ..data.prefetch import prefetch_to_mesh
+from ..resilience import checkpoint as integrity
+from ..resilience.faults import maybe_fail
+from ..resilience.preemption import PreemptionGuard
 from ..models.metrics import (
     cross_entropy_loss,
     multiclass_accuracy,
@@ -331,6 +336,10 @@ class FitResult:
     best_metric_value: float | None
     history: list[dict]
     best_checkpoint_path: str | None = None
+    # True when fit stopped early on SIGTERM (spot/TPU-VM eviction): the
+    # in-flight step finished and a resumable checkpoint was saved;
+    # fit(resume=True) continues from exactly that step.
+    preempted: bool = False
 
 
 class Trainer:
@@ -450,9 +459,55 @@ class Trainer:
             cfg, use_best=val_data_factory is not None
         )
         start_epoch = 0
+        resume_offset = 0
         if manager is not None and cfg.resume and manager.latest_step() is not None:
             state = self._restore(manager, state)
+            # If the restore fell back past unusable newer steps, they
+            # must not stay registered: the run will re-reach those step
+            # numbers and manager.save would crash on "step already
+            # exists" (and the preemption-save gate would compare against
+            # a corrupt latest). Quarantine them aside and rebuild the
+            # manager so its step cache forgets them. (Process 0 renames,
+            # same discipline as manifest writes; single-host in CI.)
+            stale = [
+                s for s in manager.all_steps() if s > int(state.step)
+            ]
+            if stale:
+                if self.topology.process_index == 0:
+                    for s in stale:
+                        integrity.quarantine_step(
+                            Path(cfg.checkpoint_dir) / str(s)
+                        )
+                # Multi-host: no collective barrier here — instead every
+                # process waits (bounded) until process 0's renames are
+                # VISIBLE on the shared checkpoint FS before rebuilding
+                # its manager, so no rebuilt manager can still list a
+                # stale step. Single-host: the renames already happened
+                # synchronously above and the loop exits immediately.
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline and any(
+                    (Path(cfg.checkpoint_dir) / str(s)).exists()
+                    for s in stale
+                ):
+                    time.sleep(0.2)
+                leftover = [
+                    s for s in stale
+                    if (Path(cfg.checkpoint_dir) / str(s)).exists()
+                ]
+                if leftover:
+                    log.warning(
+                        "stale checkpoint steps still visible after "
+                        "quarantine wait: %s — a later save of those step "
+                        "numbers may fail", leftover,
+                    )
+                manager = self._checkpoint_manager(
+                    cfg, use_best=val_data_factory is not None
+                )
+            # A preemption checkpoint lands mid-epoch: the resumed first
+            # epoch runs only the REMAINING steps, so the final step
+            # count matches an uninterrupted run exactly.
             start_epoch = int(state.step) // steps_per_epoch
+            resume_offset = int(state.step) % steps_per_epoch
 
         def batches():
             yield first
@@ -491,108 +546,151 @@ class Trainer:
         )
         step_timer = StepTimer(observer=step_hist.observe)
         tracing = False
+        preempted = False
+        guard = PreemptionGuard()
 
-        for epoch in range(start_epoch, cfg.max_epochs):
-            if data_exhausted:
-                log.warning(
-                    "train data exhausted at step %d; stopping before epoch %d "
-                    "of %d", step, epoch, cfg.max_epochs,
-                )
-                break
-            t0_wall = time.time()
-            t0 = time.perf_counter()
-            metrics = {}
-            epoch_steps = 0
-            for _ in range(steps_per_epoch):
-                wait_t0 = time.perf_counter()
-                try:
-                    batch = next(device_batches)
-                except StopIteration:
-                    data_exhausted = True
-                    break
-                wait_hist.observe(time.perf_counter() - wait_t0)
-                if cfg.profile_dir is not None and not tracing and (
-                    step >= cfg.profile_start_step
-                ):
-                    jax.profiler.start_trace(cfg.profile_dir)
-                    tracing = True
-                    trace_stop_at = step + cfg.profile_num_steps
-                state, metrics = train_step(state, batch)
-                epoch_steps += 1
-                step += 1  # host-side mirror of state.step: no device sync
-                step_timer.tick()
-                compiles.update()
-                if tracing and step >= trace_stop_at:
-                    jax.block_until_ready(state.params)
-                    jax.profiler.stop_trace()
-                    tracing = False
-                    cfg = dataclasses.replace(cfg, profile_dir=None)
-                if step % cfg.log_every_steps == 0:
-                    self._log({k: float(v) for k, v in metrics.items()}, step)
-            if epoch_steps == 0:
-                break
-            jax.block_until_ready(state.params)
-            dt = time.perf_counter() - t0
-            telemetry.get_span_log().record(
-                "train_epoch", t0_wall, dt, epoch=epoch, steps=epoch_steps
-            )
-            images_per_sec = (
-                epoch_steps
-                * per_process_batch
-                * self.topology.process_count
-                / dt
-            )
-            throughput_gauge.set(images_per_sec)
-            epoch_summary = {
-                "epoch": epoch,
-                "epoch_time_s": dt,
-                "images_per_sec": images_per_sec,
-                **step_timer.summary(),
-                **{k: float(v) for k, v in metrics.items()},
-            }
-            step_timer.reset()
-
-            if val_data_factory is not None:
-                with telemetry.span("eval", epoch=epoch):
-                    epoch_summary.update(
-                        self._evaluate(eval_step, state, val_data_factory)
+        with guard:
+            for epoch in range(start_epoch, cfg.max_epochs):
+                if data_exhausted:
+                    log.warning(
+                        "train data exhausted at step %d; stopping before "
+                        "epoch %d of %d", step, epoch, cfg.max_epochs,
                     )
+                    break
+                t0_wall = time.time()
+                t0 = time.perf_counter()
+                metrics = {}
+                epoch_steps = 0
+                steps_this_epoch = steps_per_epoch - (
+                    resume_offset if epoch == start_epoch else 0
+                )
+                for _ in range(steps_this_epoch):
+                    wait_t0 = time.perf_counter()
+                    try:
+                        batch = next(device_batches)
+                    except StopIteration:
+                        data_exhausted = True
+                        break
+                    wait_hist.observe(time.perf_counter() - wait_t0)
+                    if cfg.profile_dir is not None and not tracing and (
+                        step >= cfg.profile_start_step
+                    ):
+                        jax.profiler.start_trace(cfg.profile_dir)
+                        tracing = True
+                        trace_stop_at = step + cfg.profile_num_steps
+                    state, metrics = train_step(state, batch)
+                    epoch_steps += 1
+                    step += 1  # host-side mirror of state.step: no device sync
+                    step_timer.tick()
+                    compiles.update()
+                    if tracing and step >= trace_stop_at:
+                        jax.block_until_ready(state.params)
+                        jax.profiler.stop_trace()
+                        tracing = False
+                        cfg = dataclasses.replace(cfg, profile_dir=None)
+                    if step % cfg.log_every_steps == 0:
+                        self._log(
+                            {k: float(v) for k, v in metrics.items()}, step
+                        )
+                    if guard.triggered:
+                        break
+                if guard.triggered:
+                    # Preemption (SIGTERM): the in-flight step finished
+                    # above; save a resumable checkpoint NOW — mid-epoch —
+                    # and hand back a result marked preempted so the
+                    # caller's --resume continues from this exact step.
+                    preempted = True
+                    telemetry.counter(
+                        "preemption_signals_total",
+                        "preemption signals honored by Trainer.fit",
+                    ).inc()
+                    jax.block_until_ready(state.params)
+                    latest = (
+                        manager.latest_step() if manager is not None else None
+                    )
+                    if manager is not None and step > (
+                        latest if latest is not None else -1
+                    ):
+                        # use_best=False deliberately: a metrics-carrying
+                        # save would rank -inf under best_fn retention and
+                        # orbax would prune the preemption step IMMEDIATELY
+                        # (verified against the installed version); a
+                        # metrics-less save is exempt from best-ranking
+                        # retention, so the preserved work survives until
+                        # --resume. synchronous: the eviction grace window
+                        # is the one place the trainer must not return
+                        # before the write (and its manifest) commit.
+                        self._save(
+                            manager, cfg, state, step,
+                            metric_val=None,
+                            use_best=False,
+                            synchronous=True,
+                        )
+                    log.warning(
+                        "preempted at step %d (epoch %d); resumable "
+                        "checkpoint %s", step, epoch,
+                        "saved" if manager is not None else
+                        "NOT saved (no checkpoint_dir)",
+                    )
+                    break
+                if epoch_steps == 0:
+                    break
+                jax.block_until_ready(state.params)
+                dt = time.perf_counter() - t0
+                telemetry.get_span_log().record(
+                    "train_epoch", t0_wall, dt, epoch=epoch, steps=epoch_steps
+                )
+                images_per_sec = (
+                    epoch_steps
+                    * per_process_batch
+                    * self.topology.process_count
+                    / dt
+                )
+                throughput_gauge.set(images_per_sec)
+                epoch_summary = {
+                    "epoch": epoch,
+                    "epoch_time_s": dt,
+                    "images_per_sec": images_per_sec,
+                    **step_timer.summary(),
+                    **{k: float(v) for k, v in metrics.items()},
+                }
+                step_timer.reset()
 
-            history.append(epoch_summary)
-            self._log(
-                {k: v for k, v in epoch_summary.items() if k != "epoch"}, step
-            )
-            if epoch_callback is not None:
-                epoch_callback(dict(epoch_summary))
-
-            metric_val = epoch_summary.get(cfg.best_metric)
-            is_best = metric_val is not None and (
-                best_value is None or sign * metric_val > sign * best_value
-            )
-            if is_best:
-                best_value, best_step = metric_val, step
-            if manager is not None:
                 if val_data_factory is not None:
-                    # With best-tracking on, every save needs the metric or
-                    # orbax retention stops pruning; a missing value ranks
-                    # worst so it never wins "best".
-                    save_metrics = {
-                        cfg.best_metric: metric_val
-                        if metric_val is not None
-                        else sign * float("-inf")
-                    }
-                else:
-                    save_metrics = None
-                with telemetry.span("checkpoint", step=step):
-                    manager.save(
-                        step,
-                        args=_ocp().args.StandardSave(_to_pytree(state)),
-                        metrics=save_metrics,
+                    with telemetry.span("eval", epoch=epoch):
+                        epoch_summary.update(
+                            self._evaluate(eval_step, state, val_data_factory)
+                        )
+
+                history.append(epoch_summary)
+                self._log(
+                    {k: v for k, v in epoch_summary.items() if k != "epoch"},
+                    step,
+                )
+                if epoch_callback is not None:
+                    epoch_callback(dict(epoch_summary))
+
+                metric_val = epoch_summary.get(cfg.best_metric)
+                is_best = metric_val is not None and (
+                    best_value is None or sign * metric_val > sign * best_value
+                )
+                if is_best:
+                    best_value, best_step = metric_val, step
+                if manager is not None:
+                    self._save(
+                        manager, cfg, state, step,
+                        metric_val=metric_val,
+                        use_best=val_data_factory is not None,
                     )
         if tracing:
             jax.block_until_ready(state.params)
             jax.profiler.stop_trace()
         if manager is not None:
+            # Join the last step's manifest finalizer FIRST — it is
+            # itself inside manager.wait_until_finished(), which must not
+            # run concurrently with ours. It must land before callers
+            # read (or verify) the checkpoint dir.
+            self._join_manifest_writer()
             manager.wait_until_finished()
 
         return FitResult(
@@ -605,6 +703,7 @@ class Trainer:
                 if manager is not None and best_step is not None
                 else None
             ),
+            preempted=preempted,
         )
 
     # -- eval -------------------------------------------------------------
@@ -659,24 +758,108 @@ class Trainer:
         self, manager, cfg: TrainerConfig
     ) -> tuple[float | None, int | None]:
         """Recover best-so-far from a resumed manager so a worse post-resume
-        epoch can't claim best_checkpoint_path."""
+        epoch can't claim best_checkpoint_path.
+
+        The best step may no longer exist on disk (retention pruned it, an
+        operator cleaned it, or its files went corrupt); recover from the
+        metrics of the steps that DO remain rather than erroring or
+        pointing best_checkpoint_path at a ghost.
+        """
         if manager is None or not cfg.resume:
             return None, None
+        sign = 1.0 if cfg.best_mode == "max" else -1.0
         try:
+            steps = set(manager.all_steps())
             best_step = manager.best_step()
-            if best_step is None:
+            if best_step is not None and best_step in steps:
+                all_metrics = manager.metrics(best_step)
+                return (all_metrics or {}).get(cfg.best_metric), best_step
+            candidates = []
+            for s in steps:
+                try:
+                    m = (manager.metrics(s) or {}).get(cfg.best_metric)
+                except Exception:
+                    continue  # unreadable per-step metrics: skip that step
+                if m is not None and math.isfinite(m):
+                    candidates.append((sign * m, s))
+            if not candidates:
                 return None, None
-            all_metrics = manager.metrics(best_step)
-            return (all_metrics or {}).get(cfg.best_metric), best_step
+            _, s = max(candidates)
+            return (manager.metrics(s) or {}).get(cfg.best_metric), s
         except Exception:
             return None, None
 
+    def _save(self, manager, cfg: TrainerConfig, state: TrainState,
+              step: int, *, metric_val, use_best: bool,
+              synchronous: bool = False) -> None:
+        """One checkpoint step + its integrity manifest.
+
+        The manifest must checksum the COMMITTED files, which means
+        waiting out orbax's async write before hashing — but neither
+        belongs on the training thread (that would forfeit the
+        async-save/next-epoch overlap). The wait + hash run on a
+        background finalizer thread; the next save joins the previous
+        finalizer (long done by then), and ``fit`` joins the last one
+        before returning. ``synchronous=True`` (preemption) does it all
+        inline — the process is about to exit.
+        """
+        if use_best:
+            # With best-tracking on, every epoch save needs the metric or
+            # orbax retention stops pruning; a missing value ranks worst
+            # so it never wins "best". (Preemption saves pass
+            # use_best=False instead: a -inf-ranked step would be pruned
+            # at save time, losing the preserved work.)
+            sign = 1.0 if cfg.best_mode == "max" else -1.0
+            save_metrics = {
+                cfg.best_metric: metric_val
+                if metric_val is not None
+                else sign * float("-inf")
+            }
+        else:
+            save_metrics = None
+        # Join the previous step's finalizer BEFORE driving the manager
+        # again: its wait_until_finished() must not run concurrently with
+        # this save (orbax's async internals aren't documented
+        # thread-safe). By now it is long done — an epoch has passed.
+        self._join_manifest_writer()
+        with telemetry.span("checkpoint", step=step):
+            maybe_fail("checkpoint.save")
+            manager.save(
+                step,
+                args=_ocp().args.StandardSave(_to_pytree(state)),
+                metrics=save_metrics,
+            )
+
+        def finalize() -> None:
+            try:
+                manager.wait_until_finished()
+                # Process 0 only — the manifest is one file per step,
+                # not per host.
+                if self.topology.process_index == 0:
+                    step_dir = Path(str(manager.directory)) / str(step)
+                    if step_dir.is_dir():
+                        integrity.write_manifest(step_dir)
+            except Exception:
+                # A failed manifest leaves the step "unverified" (still
+                # restorable), never a crashed training run.
+                log.exception("manifest write failed for step %d", step)
+
+        if synchronous:
+            finalize()
+        else:
+            self._manifest_thread = threading.Thread(
+                target=finalize, daemon=True, name=f"ckpt-manifest-{step}"
+            )
+            self._manifest_thread.start()
+
+    def _join_manifest_writer(self) -> None:
+        thread = getattr(self, "_manifest_thread", None)
+        if thread is not None:
+            thread.join()
+            self._manifest_thread = None
+
     def _restore(self, manager, state: TrainState) -> TrainState:
-        ocp = _ocp()
-        restored = manager.restore(
-            manager.latest_step(),
-            args=ocp.args.StandardRestore(_to_pytree(state)),
-        )
+        restored, _ = _restore_with_fallback(manager, _to_pytree(state))
         return TrainState(**restored)
 
     def _log(self, metrics: dict, step: int) -> None:
@@ -717,6 +900,46 @@ def _zero1_shardings(opt_state, mesh: Mesh, axis: str):
     return jax.tree_util.tree_map(leaf, opt_state)
 
 
+def _restore_with_fallback(manager, template, *, steps=None):
+    """Restore the newest usable step, walking past corrupt ones.
+
+    ``steps`` (default: all steps, newest first) is the preference
+    order. Each candidate is verified against its integrity manifest
+    first; corrupt steps — and steps whose restore raises anyway (damage
+    a manifest can't see, or a pre-manifest step gone bad) — are skipped
+    with a ``checkpoint_fallback_total`` count and a warning, exactly
+    the behavior that turns "latest checkpoint truncated by the
+    preemption" from a crashed run into a one-step rollback. Returns
+    ``(restored_pytree, step)``.
+    """
+    ocp = _ocp()
+    directory = Path(str(manager.directory))
+    if steps is None:
+        steps = sorted(manager.all_steps(), reverse=True)
+    last_exc = None
+    for step in steps:
+        status, problems = integrity.verify_step(directory / str(step))
+        if status == "corrupt":
+            integrity.record_fallback(step, "; ".join(problems))
+            continue
+        try:
+            maybe_fail("checkpoint.restore")
+            restored = manager.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+        except Exception as e:
+            integrity.record_fallback(
+                step, f"restore raised {type(e).__name__}: {e}"
+            )
+            last_exc = e
+            continue
+        return restored, int(step)
+    raise FileNotFoundError(
+        f"no intact checkpoint step under {directory} "
+        f"(candidates: {list(steps)})"
+    ) from last_exc
+
+
 def restore_state(
     task,
     sample_batch: Batch,
@@ -734,6 +957,12 @@ def restore_state(
     defaults apply) and falls back to the latest step when no metrics
     were saved; ``step=`` pins an explicit step. Returns
     ``(state, step_restored)``.
+
+    Steps are verified against their integrity manifests: the preferred
+    step being corrupt falls back to the newest intact one (same walk as
+    ``Trainer`` resume), while an explicitly pinned ``step=`` that fails
+    verification raises — the caller asked for that step by name, and
+    silently serving different weights would be worse than an error.
 
     The restore is structure-matched against the task's full TrainState,
     optimizer state included (orbax restores whole templates) — callers
@@ -753,19 +982,33 @@ def restore_state(
             max_to_keep=None,
         ),
     )
-    if step is None:
-        step = manager.best_step() if prefer == "best" else None
-        if step is None:
-            step = manager.latest_step()
-    if step is None:
-        raise FileNotFoundError(f"no checkpoints under {checkpoint_dir}")
     state = task.init_state(
         rng if rng is not None else jax.random.key(0), sample_batch
     )
-    restored = manager.restore(
-        step, args=ocp.args.StandardRestore(_to_pytree(state))
+    if step is not None:
+        status, problems = integrity.verify_step(
+            Path(checkpoint_dir).absolute() / str(step)
+        )
+        if status == "corrupt":
+            raise ValueError(
+                f"pinned checkpoint step {step} under {checkpoint_dir} "
+                f"fails integrity verification: {'; '.join(problems)}"
+            )
+        restored = manager.restore(
+            step, args=ocp.args.StandardRestore(_to_pytree(state))
+        )
+        return TrainState(**restored), int(step)
+    all_steps = sorted(manager.all_steps(), reverse=True)
+    if not all_steps:
+        raise FileNotFoundError(f"no checkpoints under {checkpoint_dir}")
+    preferred = manager.best_step() if prefer == "best" else None
+    order = (
+        [preferred] if preferred is not None else []
+    ) + [s for s in all_steps if s != preferred]
+    restored, used = _restore_with_fallback(
+        manager, _to_pytree(state), steps=order
     )
-    return TrainState(**restored), int(step)
+    return TrainState(**restored), used
 
 
 def _ocp():

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import logging
 import queue
-import threading
 import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
@@ -209,9 +208,20 @@ class HostTrials(Trials):
     ``workers`` are ``host:port`` addresses of :func:`serve_trial_worker`
     processes. The driver's TPE proposes; up to ``parallelism`` trials
     evaluate concurrently, each call pinned to one worker from a pool so
-    load spreads evenly. A worker that raises — or is unreachable — fails
-    that trial only (SparkTrials isolation; the sweep continues on the
-    remaining workers).
+    load spreads evenly.
+
+    Failure semantics (the Spark-parity part):
+
+    - An *objective* exception (the worker responded; the handler
+      raised) fails that trial only — same isolation as today's
+      SparkTrials; retrying a deterministic failure would just repeat it.
+    - A *transport* failure (dead peer, timeout, truncated stream) does
+      NOT consume the eval: the worker is dropped from the pool and the
+      trial requeues onto another worker, up to ``max_retries`` times
+      with jittered backoff (``retry_total{site=trial.evaluate}``).
+    - Dropped workers get a background heartbeat probe and are
+      re-admitted when they recover (``worker_readmitted_total``)
+      instead of being gone for the rest of the sweep.
     """
 
     accepts_objective_ref = True
@@ -223,6 +233,9 @@ class HostTrials(Trials):
         rpc_timeout: float = 600.0,
         validate_ref: bool = True,
         secret: bytes | str | None = None,
+        max_retries: int = 2,
+        heartbeat_interval: float = 0.5,
+        dead_grace: float = 1.0,
     ):
         super().__init__()
         if not workers:
@@ -232,10 +245,20 @@ class HostTrials(Trials):
         self.rpc_timeout = rpc_timeout
         self.validate_ref = validate_ref
         self.secret = secret
+        self.max_retries = max_retries
+        self.heartbeat_interval = heartbeat_interval
+        self.dead_grace = dead_grace
 
     def run(self, objective, space, algo, max_evals, rng, tracker=None) -> None:
         from ..hpo.space import space_eval
-        from ..runtime.rpc import RpcRemoteError, rpc_call
+        from ..resilience.retry import RetryPolicy, call_with_retry
+        from ..resilience.workers import WorkerPool
+        from ..runtime.rpc import (
+            RpcAuthError,
+            RpcHandshakeTimeout,
+            RpcRemoteError,
+            rpc_call,
+        )
 
         ref = objective_ref(objective)
         if self.validate_ref:
@@ -250,44 +273,43 @@ class HostTrials(Trials):
                     f"objective ref {ref!r} does not resolve on the driver: "
                     f"{e!r}"
                 ) from e
-        worker_pool: queue.SimpleQueue = queue.SimpleQueue()
-        for w in self.workers:
-            worker_pool.put(w)
-        # Live-worker accounting so a sweep whose workers all die fails
-        # the remaining trials immediately instead of each one waiting
-        # out rpc_timeout in worker_pool.get (max_evals × timeout stall).
-        live_lock = threading.Lock()
-        live_count = len(self.workers)
-        pool_dead = threading.Event()
 
-        def drop_worker() -> None:
-            nonlocal live_count
-            with live_lock:
-                live_count -= 1
-                if live_count <= 0:
-                    pool_dead.set()
+        # Heartbeat probe: a plain ping with a short timeout. Probes run
+        # on background threads against workers already dropped, so they
+        # never hold up a trial; they go through rpc_call like any call
+        # (their fault site is rpc.send.ping — armable separately from
+        # the evaluate path).
+        def probe(worker) -> None:
+            rpc_call(
+                worker, "ping",
+                timeout=min(5.0, self.rpc_timeout), secret=self.secret,
+            )
 
-        def get_worker():
-            """Pool get that aborts as soon as the pool has no live workers."""
-            deadline = time.monotonic() + self.rpc_timeout
-            while not pool_dead.is_set():
-                try:
-                    return worker_pool.get(
-                        timeout=min(0.1, max(0.0, deadline - time.monotonic()))
-                    )
-                except queue.Empty:
-                    if time.monotonic() >= deadline:
-                        return None
-            return None
+        # Pool is local to each run, like the device pool above: a
+        # resumed sweep must not duplicate worker entries or inherit a
+        # previous run's dropped/probing state.
+        pool = WorkerPool(
+            self.workers,
+            probe=probe,
+            heartbeat_interval=self.heartbeat_interval,
+            dead_grace=self.dead_grace,
+        )
+        policy = RetryPolicy(max_retries=self.max_retries, base_delay=0.1,
+                             max_delay=1.0)
 
-        def evaluate(tid: int, point: dict):
-            t0 = time.time()
-            worker = get_worker()
+        class _Requeue(ConnectionError):
+            """Transport failure already handled (worker dropped); the
+            retry wrapper should re-run the attempt on another worker."""
+
+        def attempt(tid: int, point: dict) -> dict:
+            worker = pool.get(timeout=self.rpc_timeout)
             if worker is None:
-                return tid, point, {
+                # Permanent pool death is not retryable: every remaining
+                # attempt would see the same empty pool.
+                return {
                     "status": "fail",
                     "error": "no live workers (all busy, dead, or timed out)",
-                }, t0
+                }
             try:
                 # Driver-side trial span: covers the whole remote round
                 # trip (the worker records its own compute-only span).
@@ -301,26 +323,79 @@ class HostTrials(Trials):
                     )
             except RpcRemoteError as e:
                 # The worker responded — it is healthy; the handler raised
-                # (e.g. unresolvable ref). Trial fails, worker returns.
-                worker_pool.put(worker)
-                result = {"status": "fail", "error": f"worker {worker}: {e}"}
-            except Exception:
-                # Transport failure: the worker is dead, or still chewing on
-                # the evaluation we just abandoned (timeout). Returning it
-                # would stack concurrent evaluations on a struggling host —
-                # drop it from the pool instead.
-                import traceback as _tb
+                # (e.g. unresolvable ref, a raising objective outside the
+                # result protocol). Permanent: trial fails, worker returns.
+                pool.put(worker)
+                return {"status": "fail", "error": f"worker {worker}: {e}"}
+            except RpcAuthError as e:
+                if isinstance(e, RpcHandshakeTimeout):
+                    # A stalled handshake is NOT provably a wrong secret:
+                    # a hung-but-accepting host looks exactly like this.
+                    # Transport semantics — drop (heartbeat probes it)
+                    # and requeue — so a zombie worker doesn't stay
+                    # pooled burning 10 s per trial.
+                    pool.drop(worker)
+                    raise _Requeue(
+                        f"worker {worker} dropped: handshake stalled: {e}"
+                    ) from e
+                # Digest rejection: deterministic misconfiguration, not a
+                # transport outage — retrying or heartbeat-probing with
+                # the same wrong secret can never succeed. Fail the trial
+                # loudly, naming auth, and keep the worker pooled so the
+                # sweep fails fast everywhere rather than masking the
+                # cause behind dropped-worker noise.
+                pool.put(worker)
+                return {
+                    "status": "fail",
+                    "error": f"worker {worker} auth failure: {e}",
+                }
+            except Exception as e:
+                # Transport failure: the worker is dead, or still chewing
+                # on the evaluation we just abandoned (timeout). Returning
+                # it would stack concurrent evaluations on a struggling
+                # host — drop it (heartbeat re-admits on recovery) and
+                # requeue the trial onto another worker. A worker that
+                # timed out MID-EVALUATION gets a probe cool-down of the
+                # full rpc_timeout: its threaded server would answer a
+                # ping instantly while still computing the abandoned
+                # evaluation, and an immediate re-admission would pile a
+                # second one on top. Connect-phase timeouts raise
+                # RpcConnectTimeout (a ConnectionError, not TimeoutError)
+                # — nothing was delivered, so probe immediately.
+                pool.drop(
+                    worker,
+                    cooldown=(
+                        self.rpc_timeout
+                        if isinstance(e, TimeoutError) else 0.0
+                    ),
+                )
+                raise _Requeue(
+                    f"worker {worker} dropped: {type(e).__name__}: {e}"
+                ) from e
+            else:
+                pool.put(worker)
+            return result
 
-                drop_worker()
+        def evaluate(tid: int, point: dict):
+            t0 = time.time()
+            try:
+                result = call_with_retry(
+                    attempt, tid, point,
+                    policy=policy,
+                    retryable=lambda e: isinstance(e, _Requeue),
+                    site="trial.evaluate",
+                )
+            except _Requeue as e:
                 result = {
                     "status": "fail",
-                    "error": f"worker {worker} dropped: {_tb.format_exc()}",
+                    "error": f"{e} (transport retries exhausted)",
                 }
-            else:
-                worker_pool.put(worker)
             return tid, point, result, t0
 
-        _run_async_pool(
-            self, evaluate, algo, space, max_evals, rng, tracker,
-            self.parallelism,
-        )
+        try:
+            _run_async_pool(
+                self, evaluate, algo, space, max_evals, rng, tracker,
+                self.parallelism,
+            )
+        finally:
+            pool.close()

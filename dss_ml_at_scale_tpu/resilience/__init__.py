@@ -1,0 +1,55 @@
+"""Fault-tolerance layer: injection, retry, integrity, preemption.
+
+The reference's whole value proposition is that scale-out survives
+partial failure — SparkTrials keeps a sweep alive when a trial fails and
+Spark reschedules lost executors. This package is the TPU-native
+equivalent for the seams Spark used to cover:
+
+- :mod:`.faults` — deterministic fault injection at named sites
+  (``rpc.send``, ``trial.evaluate``, ``checkpoint.save``,
+  ``checkpoint.restore``, ``reader.next``), armed by a seeded
+  :class:`FaultPlan` so every robustness behavior is testable in tier-1
+  without real hardware failures. Zero-cost no-op when disarmed.
+- :mod:`.retry` — exponential backoff with full jitter, deadline-aware,
+  plus the retryable-exception classifier that separates *transport*
+  failures (retryable) from *semantic* ones (permanent).
+- :mod:`.workers` — condition-based worker pool with live/dropped
+  accounting and background heartbeat probes that re-admit recovered
+  workers instead of losing them for the rest of a sweep.
+- :mod:`.checkpoint` — per-step content-checksum manifests written at
+  save and verified at restore, so a truncated latest step falls back to
+  the newest intact one instead of crashing the run.
+- :mod:`.preemption` — SIGTERM guard for the training loop: finish the
+  in-flight step, save, return a resumable ``preempted`` result.
+
+Recovery events meter themselves on the process telemetry registry:
+``retry_total{site=}``, ``worker_readmitted_total``,
+``checkpoint_fallback_total``, ``faults_injected_total{site=}``.
+"""
+
+from __future__ import annotations
+
+from .checkpoint import MANIFEST_NAME, verify_checkpoint_dir, verify_step, write_manifest  # noqa: F401
+from .faults import FaultPlan, InjectedFault, active_plan, clear, install, install_from_spec, maybe_fail  # noqa: F401
+from .preemption import PreemptionGuard  # noqa: F401
+from .retry import RetryPolicy, call_with_retry, is_transient  # noqa: F401
+from .workers import WorkerPool  # noqa: F401
+
+__all__ = [
+    "FaultPlan",
+    "InjectedFault",
+    "MANIFEST_NAME",
+    "PreemptionGuard",
+    "RetryPolicy",
+    "WorkerPool",
+    "active_plan",
+    "call_with_retry",
+    "clear",
+    "install",
+    "install_from_spec",
+    "is_transient",
+    "maybe_fail",
+    "verify_checkpoint_dir",
+    "verify_step",
+    "write_manifest",
+]

@@ -1,0 +1,175 @@
+"""Deterministic fault injection at named sites.
+
+Every robustness behavior in this package — retry/backoff, worker
+re-admission, checkpoint fallback — must be provable in tier-1 without
+real hardware failures. Production code marks its failure-prone seams
+with :func:`maybe_fail`; a seeded :class:`FaultPlan` (installed
+programmatically, via the ``DSST_FAULT_PLAN`` env var, or the CLI's
+``--fault-plan`` flag) arms chosen sites with exact trigger counts or
+seeded per-hit probabilities. Disarmed — the production default — a
+site check is one global read and a ``None`` comparison.
+
+Plan spec grammar (semicolon-separated entries)::
+
+    rpc.send.evaluate=2          # fail the first 2 hits of this site
+    reader.next=p0.25            # fail each hit with probability 0.25
+    checkpoint.restore=1;seed=7  # seed the probability draws
+
+Site names are dotted paths; a spec entry matches a checked site when it
+is equal to it or a dotted prefix of it (``rpc.send`` arms
+``rpc.send.evaluate`` and ``rpc.send.ping``; the most specific entry
+wins). Injected failures raise :class:`InjectedFault`, a
+``ConnectionError`` subclass so the transport-failure classifiers treat
+it exactly like a real dead peer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import threading
+import zlib
+
+from .. import telemetry
+
+log = logging.getLogger(__name__)
+
+
+class InjectedFault(ConnectionError):
+    """A failure injected by the active :class:`FaultPlan`."""
+
+
+@dataclasses.dataclass
+class _Site:
+    """Arming state for one plan entry."""
+
+    count: int | None = None      # exact-count mode: fail the next N hits
+    probability: float = 0.0      # probability mode: seeded per-hit draw
+    hits: int = 0                 # matching maybe_fail() calls observed
+    fired: int = 0                # faults actually raised
+
+
+class FaultPlan:
+    """A seeded, thread-safe set of armed fault sites."""
+
+    def __init__(self, sites: dict[str, _Site] | None = None, seed: int = 0):
+        self._lock = threading.Lock()
+        self._sites = dict(sites or {})
+        self.seed = seed
+        self._rngs: dict[str, random.Random] = {}
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse ``"site=N;site=pX;seed=S"`` into a plan.
+
+        Raises ``ValueError`` on malformed entries — a typo'd chaos plan
+        must fail the run loudly, not silently inject nothing.
+        """
+        sites: dict[str, _Site] = {}
+        seed = 0
+        for raw in spec.split(";"):
+            entry = raw.strip()
+            if not entry:
+                continue
+            name, sep, value = entry.partition("=")
+            name, value = name.strip(), value.strip()
+            if not sep or not name or not value:
+                raise ValueError(f"fault plan entry {entry!r} is not site=value")
+            if name == "seed":
+                seed = int(value)
+            elif value.startswith("p"):
+                p = float(value[1:])
+                if not 0.0 <= p <= 1.0:
+                    raise ValueError(
+                        f"fault probability must be in [0, 1], got {entry!r}"
+                    )
+                sites[name] = _Site(probability=p)
+            else:
+                n = int(value)
+                if n < 0:
+                    raise ValueError(f"fault count must be >= 0, got {entry!r}")
+                sites[name] = _Site(count=n)
+        plan = cls(sites, seed=seed)
+        return plan
+
+    def _match(self, site: str) -> tuple[str, _Site] | None:
+        """Most-specific armed entry equal to or a dotted prefix of ``site``."""
+        probe = site
+        while probe:
+            armed = self._sites.get(probe)
+            if armed is not None:
+                return probe, armed
+            probe, _, _ = probe.rpartition(".")
+        return None
+
+    def check(self, site: str) -> None:
+        """Raise :class:`InjectedFault` if the plan arms this hit."""
+        with self._lock:
+            hit = self._match(site)
+            if hit is None:
+                return
+            name, armed = hit
+            armed.hits += 1
+            fire = False
+            if armed.count is not None:
+                if armed.count > 0:
+                    armed.count -= 1
+                    fire = True
+            elif armed.probability > 0.0:
+                rng = self._rngs.get(name)
+                if rng is None:
+                    # Stable per-site stream: independent of dict order,
+                    # check order across sites, and PYTHONHASHSEED.
+                    rng = self._rngs[name] = random.Random(
+                        self.seed ^ zlib.crc32(name.encode())
+                    )
+                fire = rng.random() < armed.probability
+            if fire:
+                armed.fired += 1
+        if fire:
+            telemetry.counter(
+                "faults_injected_total", "faults raised by the active "
+                "FaultPlan", labels=("site",),
+            ).labels(site=name).inc()
+            log.warning("fault plan: injecting fault at site %r", site)
+            raise InjectedFault(f"injected fault at site {site!r}")
+
+    def stats(self) -> dict[str, dict[str, int]]:
+        """Per-entry ``{"hits": n, "fired": n}`` — what tests assert on."""
+        with self._lock:
+            return {
+                name: {"hits": s.hits, "fired": s.fired}
+                for name, s in self._sites.items()
+            }
+
+
+# -- process-global plan -----------------------------------------------------
+
+_plan: FaultPlan | None = None
+
+
+def install(plan: FaultPlan | None) -> FaultPlan | None:
+    """Install ``plan`` as the process fault plan (None disarms)."""
+    global _plan
+    _plan = plan
+    return plan
+
+
+def install_from_spec(spec: str | None) -> FaultPlan | None:
+    """Parse and install a plan spec; None/empty disarms. Returns the plan."""
+    return install(FaultPlan.parse(spec) if spec else None)
+
+
+def clear() -> None:
+    install(None)
+
+
+def active_plan() -> FaultPlan | None:
+    return _plan
+
+
+def maybe_fail(site: str) -> None:
+    """The site marker production code calls; no-op unless a plan is armed."""
+    if _plan is not None:
+        _plan.check(site)

@@ -1,0 +1,182 @@
+"""Worker pool with liveness accounting and heartbeat re-admission.
+
+The SparkTrials property this restores: Spark reschedules work from a
+lost executor and welcomes the executor back when it rejoins. The old
+``HostTrials`` pool was a bare queue — one transport error removed a
+worker for the rest of the sweep, and waiters polled a 100 ms timeout
+loop to notice pool death. This pool is condition-based:
+
+- ``get``/``put`` block and wake promptly (a re-admitted or requeued
+  worker wakes waiters immediately — no polling);
+- ``drop`` removes a worker from the live set and starts a background
+  heartbeat probe; when the probe succeeds the worker is re-admitted
+  and ``worker_readmitted_total`` increments;
+- when NO workers are live, ``get`` waits only a short ``dead_grace``
+  for a heartbeat recovery before giving up, so a sweep whose workers
+  are all permanently dead fails fast instead of serializing full
+  timeouts per trial.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable
+
+from .. import telemetry
+
+log = logging.getLogger(__name__)
+
+
+class WorkerPool:
+    """Thread-safe pool of worker identities with drop/heartbeat/readmit."""
+
+    def __init__(
+        self,
+        workers: Iterable,
+        *,
+        probe: Callable | None = None,
+        heartbeat_interval: float = 0.5,
+        dead_grace: float = 1.0,
+    ):
+        workers = list(workers)
+        self._cond = threading.Condition()
+        self._idle: deque = deque(workers)
+        self._live: set = set(workers)
+        self._probing: set = set()
+        self._probe = probe
+        self.heartbeat_interval = heartbeat_interval
+        self.dead_grace = dead_grace
+        self._closed = False
+        # Heartbeats wait on their own event, NOT on _cond: a put()
+        # wakeup must never be consumed by a prober while a get() waiter
+        # sleeps out its full timeout next to an idle worker.
+        self._closed_event = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._readmitted = telemetry.counter(
+            "worker_readmitted_total",
+            "dropped workers re-admitted after a heartbeat recovery",
+        )
+
+    # -- checkout ---------------------------------------------------------
+
+    def get(self, timeout: float):
+        """An idle worker, or None on timeout / permanent pool death.
+
+        While live workers exist (even if all checked out), waits up to
+        ``timeout``. Once none are live, waits at most ``dead_grace``
+        for a heartbeat re-admission — bounded, so all-dead sweeps fail
+        fast — and wakes immediately when one lands.
+        """
+        deadline = time.monotonic() + timeout
+        empty_since: float | None = None
+        with self._cond:
+            while True:
+                if self._idle:
+                    return self._idle.popleft()
+                if self._closed:
+                    return None
+                now = time.monotonic()
+                if self._live:
+                    empty_since = None
+                    limit = deadline
+                else:
+                    if not self._probing:
+                        return None  # nothing live, nothing recovering
+                    if empty_since is None:
+                        empty_since = now
+                    limit = min(deadline, empty_since + self.dead_grace)
+                if now >= limit:
+                    return None
+                self._cond.wait(limit - now)
+
+    def put(self, worker) -> None:
+        """Return a checked-out worker; wakes one waiter promptly."""
+        with self._cond:
+            self._idle.append(worker)
+            self._cond.notify()
+
+    # -- failure / recovery -----------------------------------------------
+
+    def drop(self, worker, cooldown: float = 0.0) -> None:
+        """Remove a (checked-out) worker from the live set.
+
+        Starts a background heartbeat that re-admits it when the probe
+        succeeds, waiting ``cooldown`` seconds before the first probe —
+        a worker dropped for a *timeout* is likely still chewing on the
+        abandoned work and would answer a ping instantly (the RPC server
+        is threaded), so probing it right away would stack concurrent
+        evaluations on a struggling host. notify_all so waiters
+        re-evaluate liveness promptly — the last live worker dying must
+        not leave them blocked on a full checkout timeout.
+        """
+        with self._cond:
+            self._live.discard(worker)
+            start_probe = (
+                self._probe is not None
+                and not self._closed
+                and worker not in self._probing
+            )
+            if start_probe:
+                self._probing.add(worker)
+            self._cond.notify_all()
+        if start_probe:
+            t = threading.Thread(
+                target=self._heartbeat, args=(worker, cooldown), daemon=True,
+                name=f"worker-heartbeat-{worker}",
+            )
+            # Prune finished heartbeats so a flappy worker doesn't grow
+            # the list one dead Thread per drop/readmit cycle.
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+            t.start()
+
+    def readmit(self, worker) -> None:
+        with self._cond:
+            if self._closed or worker in self._live:
+                return
+            self._live.add(worker)
+            self._idle.append(worker)
+            self._probing.discard(worker)
+            self._cond.notify_all()
+        self._readmitted.inc()
+        log.warning("worker %s recovered; re-admitted to the pool", worker)
+
+    def _heartbeat(self, worker, cooldown: float = 0.0) -> None:
+        if cooldown > 0.0 and self._closed_event.wait(cooldown):
+            return
+        while not self._closed_event.wait(self.heartbeat_interval):
+            with self._cond:
+                if self._closed or worker not in self._probing:
+                    return
+            try:
+                self._probe(worker)
+            except Exception:
+                continue  # still down; keep probing
+            self.readmit(worker)
+            return
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    @property
+    def live_count(self) -> int:
+        with self._cond:
+            return len(self._live)
+
+    @property
+    def probing_count(self) -> int:
+        with self._cond:
+            return len(self._probing)
+
+    def close(self) -> None:
+        """Stop heartbeats and wake every waiter (they see None)."""
+        with self._cond:
+            self._closed = True
+            self._probing.clear()
+            self._cond.notify_all()
+        self._closed_event.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = []

@@ -9,4 +9,11 @@ from .mesh import (  # noqa: F401
 )
 from .topology import Topology, local_topology  # noqa: F401
 from .distributed import initialize_distributed  # noqa: F401
-from .rpc import RpcAuthError, RpcRemoteError, RpcServer, rpc_call  # noqa: F401
+from .rpc import (  # noqa: F401
+    RpcAuthError,
+    RpcConnectTimeout,
+    RpcHandshakeTimeout,
+    RpcRemoteError,
+    RpcServer,
+    rpc_call,
+)

@@ -50,6 +50,26 @@ class RpcAuthError(ConnectionError):
     """HMAC challenge handshake failed (wrong or missing shared secret)."""
 
 
+class RpcHandshakeTimeout(RpcAuthError):
+    """Auth handshake stalled — a hung peer or one speaking no auth.
+
+    Unlike a digest rejection (provably the wrong secret), a stalled
+    handshake may just be a wedged host: callers with a worker pool
+    should treat this as a transport failure (drop + probe), not a
+    deterministic misconfiguration.
+    """
+
+
+class RpcConnectTimeout(ConnectionError):
+    """TCP connect timed out before any request was delivered.
+
+    Deliberately NOT a TimeoutError subclass: a post-connect timeout
+    means the peer may still be computing the abandoned request (callers
+    should cool down before re-admitting it), while a connect timeout
+    delivered nothing — the peer can be probed again immediately.
+    """
+
+
 def _send_msg(sock: socket.socket, obj: Any) -> None:
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(_LEN.pack(len(data)) + data)
@@ -210,38 +230,73 @@ def rpc_call(
     payload: Any = None,
     timeout: float | None = 600.0,
     secret: bytes | str | None = None,
+    retry=None,
 ):
     """One call: connect, send, await response, raise on remote error.
 
     With ``secret`` set, answers the server's HMAC challenge and issues
     our own before anything is unpickled from the connection.
+
+    ``retry`` (a :class:`~dss_ml_at_scale_tpu.resilience.RetryPolicy`)
+    re-attempts *transport* failures — dead peer, timeout, truncated
+    stream — with jittered backoff; remote-handler and auth errors are
+    never retried (deterministic outcomes don't improve on repeat).
+    Each attempt passes the ``rpc.send.<method>`` fault-injection site.
     """
     if isinstance(address, str):
         host, _, port = address.rpartition(":")
         address = (host or "127.0.0.1", int(port))
     key = _normalize_secret(secret)
-    with socket.create_connection(address, timeout=timeout) as sock:
-        if key is not None:
-            # Handshake frames are tiny; a server that doesn't speak the
-            # auth protocol (no secret configured) simply never sends the
-            # challenge. Bound that wait tightly and name the cause, so a
-            # driver/worker secret mismatch fails in seconds with an auth
-            # error rather than stalling out the full call timeout.
-            sock.settimeout(min(10.0, timeout) if timeout else 10.0)
-            try:
-                _answer_challenge(sock, key)
-                _deliver_challenge(sock, key)
-            except (TimeoutError, socket.timeout) as e:
-                raise RpcAuthError(
-                    f"handshake with {address} timed out — peer likely has "
-                    "no secret configured (or a different protocol)"
-                ) from e
-            sock.settimeout(timeout)
-        _send_msg(sock, {"method": method, "payload": payload})
-        resp = _recv_msg(sock)
+
+    def _attempt() -> Any:
+        _maybe_fail(f"rpc.send.{method}")
+        try:
+            sock = socket.create_connection(address, timeout=timeout)
+        except (TimeoutError, socket.timeout) as e:
+            raise RpcConnectTimeout(
+                f"connect to {address} timed out after {timeout}s"
+            ) from e
+        with sock:
+            if key is not None:
+                # Handshake frames are tiny; a server that doesn't speak
+                # the auth protocol (no secret configured) simply never
+                # sends the challenge. Bound that wait tightly and name
+                # the cause, so a driver/worker secret mismatch fails in
+                # seconds with an auth error rather than stalling out
+                # the full call timeout.
+                sock.settimeout(min(10.0, timeout) if timeout else 10.0)
+                try:
+                    _answer_challenge(sock, key)
+                    _deliver_challenge(sock, key)
+                except (TimeoutError, socket.timeout) as e:
+                    raise RpcHandshakeTimeout(
+                        f"handshake with {address} timed out — peer likely "
+                        "has no secret configured (or a different protocol), "
+                        "or is hung"
+                    ) from e
+                sock.settimeout(timeout)
+            _send_msg(sock, {"method": method, "payload": payload})
+            return _recv_msg(sock)
+
+    if retry is None:
+        resp = _attempt()
+    else:
+        from ..resilience.retry import call_with_retry
+
+        resp = call_with_retry(
+            _attempt, policy=retry, site=f"rpc.send.{method}"
+        )
     if not resp["ok"]:
         raise RpcRemoteError(resp["error"])
     return resp["value"]
+
+
+def _maybe_fail(site: str) -> None:
+    # Local indirection so the transport has no import-time dependency on
+    # the resilience package (which itself rides on telemetry).
+    from ..resilience.faults import maybe_fail
+
+    maybe_fail(site)
 
 
 class RpcRemoteError(RuntimeError):

@@ -44,6 +44,33 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 
+def _wait_ready(host: str, port: int, timeout_s: float = 30.0) -> None:
+    """Poll /healthz until the server answers, with bounded backoff.
+
+    A freshly spawned server (the --selftest subprocess, or a real `dsst
+    serve` still compiling its scorer) announces its port before the
+    accept loop is warm; connection-refused during that window must not
+    fail the whole selftest. Raises the last error once the budget is
+    spent — a server that never comes up is still a loud failure.
+    """
+    deadline = time.monotonic() + timeout_s
+    delay = 0.05
+    while True:
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=5)
+            try:
+                conn.request("GET", "/healthz")
+                conn.getresponse().read()
+            finally:
+                conn.close()
+            return
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            delay = min(delay * 2, 1.0)
+
+
 def _scrape(host: str, port: int) -> dict:
     """Histogram/counter samples from /metrics (Prometheus text)."""
     conn = http.client.HTTPConnection(host, port, timeout=10)
@@ -271,6 +298,7 @@ def main(argv=None) -> int:
         body = Path(args.image).read_bytes()
 
     try:
+        _wait_ready(host, port)
         report = {
             "bench": "serve_loadgen",
             "mode": "selftest" if args.selftest else "url",

@@ -1,0 +1,702 @@
+"""Fault-tolerance layer: injection, retry, worker re-admission,
+checkpoint integrity fallback, preemption-safe training (PR 3).
+
+The chaos tests run real components — in-process RPC workers, the real
+Trainer with orbax checkpoints — under a deterministic seeded FaultPlan,
+so every recovery path is exercised without real hardware failures."""
+
+import json
+import os
+import signal
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dss_ml_at_scale_tpu import telemetry
+from dss_ml_at_scale_tpu.hpo import STATUS_OK, fmin, hp
+from dss_ml_at_scale_tpu.parallel import HostTrials, serve_trial_worker
+from dss_ml_at_scale_tpu.resilience import (
+    FaultPlan,
+    InjectedFault,
+    MANIFEST_NAME,
+    RetryPolicy,
+    WorkerPool,
+    call_with_retry,
+    faults,
+    is_transient,
+    verify_step,
+    write_manifest,
+)
+from dss_ml_at_scale_tpu.runtime.rpc import RpcAuthError, RpcRemoteError
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No fault plan leaks across tests."""
+    yield
+    faults.clear()
+
+
+def _counter(name, **labels):
+    """Current value of a default-registry counter (0 when unregistered)."""
+    for m in telemetry.snapshot()["metrics"]:
+        if m["name"] == name and (m.get("labels") or {}) == labels:
+            return m["value"]
+    return 0.0
+
+
+# -- fault plans -------------------------------------------------------------
+
+def test_fault_plan_exact_counts_and_prefix_match():
+    plan = faults.install(FaultPlan.parse("rpc.send=2;seed=5"))
+    # Prefix entries arm every dotted-suffix site; the first 2 hits fire.
+    with pytest.raises(InjectedFault):
+        faults.maybe_fail("rpc.send.evaluate")
+    with pytest.raises(InjectedFault):
+        faults.maybe_fail("rpc.send.ping")
+    faults.maybe_fail("rpc.send.evaluate")  # count exhausted: no-op
+    faults.maybe_fail("checkpoint.save")    # unarmed site: no-op
+    assert plan.stats()["rpc.send"] == {"hits": 3, "fired": 2}
+
+
+def test_fault_plan_most_specific_entry_wins():
+    faults.install(FaultPlan.parse("rpc.send=0;rpc.send.evaluate=1"))
+    faults.maybe_fail("rpc.send.ping")  # matches the disarmed prefix
+    with pytest.raises(InjectedFault):
+        faults.maybe_fail("rpc.send.evaluate")
+
+
+def test_fault_plan_seeded_probability_is_deterministic():
+    def fires(seed):
+        plan = FaultPlan.parse(f"reader.next=p0.5;seed={seed}")
+        out = []
+        for _ in range(40):
+            try:
+                plan.check("reader.next")
+                out.append(False)
+            except InjectedFault:
+                out.append(True)
+        return out
+
+    a, b = fires(7), fires(7)
+    assert a == b              # same seed, same firing pattern
+    assert any(a) and not all(a)
+    assert fires(8) != a       # a different seed changes the pattern
+
+
+def test_fault_plan_parse_rejects_garbage():
+    for bad in ("rpc.send", "rpc.send=p1.5", "rpc.send=-1", "=3"):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+
+def test_maybe_fail_is_noop_when_disarmed():
+    faults.clear()
+    faults.maybe_fail("rpc.send.evaluate")  # must not raise
+    assert faults.active_plan() is None
+
+
+# -- retry policy ------------------------------------------------------------
+
+def test_retry_recovers_transient_failures_and_meters():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionResetError("blip")
+        return "ok"
+
+    before = _counter("retry_total", site="t")
+    out = call_with_retry(
+        flaky, policy=RetryPolicy(max_retries=3, base_delay=0.001),
+        site="t", sleep=lambda s: None,
+    )
+    assert out == "ok" and calls["n"] == 3
+    assert _counter("retry_total", site="t") - before == 2
+
+
+def test_retry_gives_up_after_max_retries():
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise TimeoutError("still down")
+
+    with pytest.raises(TimeoutError):
+        call_with_retry(
+            always_down, policy=RetryPolicy(max_retries=2, base_delay=0.001),
+            sleep=lambda s: None,
+        )
+    assert calls["n"] == 3  # first attempt + 2 retries
+
+
+def test_retry_never_replays_semantic_failures():
+    calls = {"n": 0}
+
+    def semantic():
+        calls["n"] += 1
+        raise RpcRemoteError("handler raised")
+
+    with pytest.raises(RpcRemoteError):
+        call_with_retry(
+            semantic, policy=RetryPolicy(max_retries=5, base_delay=0.001),
+            sleep=lambda s: None,
+        )
+    assert calls["n"] == 1
+
+
+def test_retry_deadline_bounds_total_time():
+    def always_down():
+        raise ConnectionError("down")
+
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        call_with_retry(
+            always_down,
+            policy=RetryPolicy(
+                max_retries=100, base_delay=0.2, max_delay=0.2, deadline=0.3
+            ),
+        )
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_transient_classifier():
+    from dss_ml_at_scale_tpu.runtime.rpc import (
+        RpcConnectTimeout,
+        RpcHandshakeTimeout,
+    )
+
+    assert is_transient(ConnectionRefusedError("x"))
+    assert is_transient(TimeoutError("x"))
+    assert is_transient(EOFError("x"))
+    assert is_transient(InjectedFault("x"))
+    # A stalled handshake may just be a wedged peer — transport-shaped.
+    assert is_transient(RpcHandshakeTimeout("handshake timed out"))
+    # Connect timeouts are ConnectionError (retryable) but deliberately
+    # NOT TimeoutError (no probe cool-down: nothing was ever delivered).
+    assert is_transient(RpcConnectTimeout("connect timed out"))
+    assert not isinstance(RpcConnectTimeout("x"), TimeoutError)
+    assert not is_transient(RpcRemoteError("handler traceback"))
+    assert not is_transient(RpcAuthError("bad secret"))
+    assert not is_transient(ValueError("semantic"))
+
+
+def test_rpc_call_retry_param_recovers_injected_transport_faults():
+    from dss_ml_at_scale_tpu.runtime.rpc import RpcServer, rpc_call
+
+    server = RpcServer({"echo": lambda p: p}).serve_background()
+    plan = faults.install(FaultPlan.parse("rpc.send.echo=2"))
+    before = _counter("retry_total", site="rpc.send.echo")
+    try:
+        # Without retry: the injected transport fault surfaces.
+        with pytest.raises(InjectedFault):
+            rpc_call(server.address, "echo", 1)
+        # With retry: the remaining armed fault is absorbed by a retry.
+        assert rpc_call(
+            server.address, "echo", 42,
+            retry=RetryPolicy(max_retries=2, base_delay=0.01),
+        ) == 42
+        # Remote-handler errors are never retried, even with retry set.
+        with pytest.raises(RpcRemoteError):
+            rpc_call(
+                server.address, "missing", None,
+                retry=RetryPolicy(max_retries=3, base_delay=0.01),
+            )
+    finally:
+        server.shutdown()
+    assert plan.stats()["rpc.send.echo"]["fired"] == 2
+    assert _counter("retry_total", site="rpc.send.echo") - before == 1
+
+
+# -- worker pool -------------------------------------------------------------
+
+def test_worker_pool_drop_wakes_waiters_promptly():
+    # Satellite: a waiter blocked in get() while another trial holds the
+    # last live worker must wake as soon as the pool dies — not spin out
+    # its full checkout timeout.
+    pool = WorkerPool(["a", "b"], probe=None, dead_grace=0.2)
+    a, b = pool.get(1.0), pool.get(1.0)
+    out = []
+
+    def waiter():
+        t0 = time.monotonic()
+        out.append((pool.get(10.0), time.monotonic() - t0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.15)
+    pool.drop(a)
+    pool.drop(b)  # last live worker gone mid-wait
+    t.join(5.0)
+    pool.close()
+    got, waited = out[0]
+    # probe=None → no recovery possible → None immediately, not at 10 s.
+    assert got is None and waited < 2.0
+
+
+def test_worker_pool_readmits_on_heartbeat_and_wakes_waiters():
+    before = _counter("worker_readmitted_total")
+    pool = WorkerPool(
+        ["w"], probe=lambda w: None, heartbeat_interval=0.05, dead_grace=5.0
+    )
+    w = pool.get(1.0)
+    pool.drop(w)
+    t0 = time.monotonic()
+    got = pool.get(10.0)  # heartbeat succeeds → readmit → waiter wakes
+    waited = time.monotonic() - t0
+    pool.close()
+    assert got == "w" and waited < 2.0
+    assert _counter("worker_readmitted_total") - before == 1
+
+
+def test_worker_pool_put_wakes_waiter():
+    pool = WorkerPool(["w"], probe=None)
+    w = pool.get(1.0)
+    out = []
+    t = threading.Thread(target=lambda: out.append(pool.get(10.0)))
+    t.start()
+    time.sleep(0.1)
+    pool.put(w)
+    t.join(2.0)
+    pool.close()
+    assert out == ["w"]
+
+
+# -- chaos sweep: transport faults + worker death + re-admission -------------
+
+def test_chaos_sweep_completes_with_faults_and_worker_death():
+    """The acceptance chaos test: a 2-worker HostTrials sweep under a
+    fault plan (2 injected transport faults) plus one real worker death
+    mid-sweep completes every eval ok, with the transport-faulted trials
+    retried onto live workers and the dead worker re-admitted by its
+    heartbeat once it comes back."""
+    servers = [serve_trial_worker(block=False) for _ in range(2)]
+    addrs = [f"{s.address[0]}:{s.address[1]}" for s in servers]
+    dead_port = servers[1].address[1]
+    servers[1].shutdown()  # worker death before the sweep starts
+
+    def resurrect():
+        time.sleep(0.6)
+        servers[1] = serve_trial_worker(
+            bind=f"127.0.0.1:{dead_port}", block=False
+        )
+
+    threading.Thread(target=resurrect, daemon=True).start()
+    plan = faults.install(FaultPlan.parse("rpc.send.evaluate=2"))
+    readmitted_before = _counter("worker_readmitted_total")
+    retries_before = _counter("retry_total", site="trial.evaluate")
+    trials = HostTrials(
+        addrs, parallelism=2, rpc_timeout=15.0, max_retries=3,
+        heartbeat_interval=0.1, dead_grace=2.0,
+    )
+    try:
+        best = fmin(
+            "dss_ml_at_scale_tpu.hpo.objectives:paced_quadratic",
+            {"x": hp.uniform("x", -10, 10),
+             "delay": hp.choice("delay", [0.15])},
+            max_evals=12,
+            trials=trials,
+            rstate=np.random.default_rng(0),
+        )
+    finally:
+        for s in servers:
+            s.shutdown()
+    assert len(trials.trials) == 12
+    assert all(t["result"]["status"] == STATUS_OK for t in trials.trials)
+    assert "x" in best
+    # Both injected transport faults fired and were retried to ok...
+    assert plan.stats()["rpc.send.evaluate"]["fired"] == 2
+    assert _counter("retry_total", site="trial.evaluate") - retries_before >= 2
+    # ...and the dead worker came back via its heartbeat.
+    assert _counter("worker_readmitted_total") - readmitted_before >= 1
+
+
+def test_host_trials_wrong_secret_fails_fast_naming_auth():
+    # A digest rejection is deterministic misconfiguration: no retries,
+    # no worker drop — every trial fails quickly with an auth-named
+    # error instead of the sweep masking the cause as a transport outage.
+    server = serve_trial_worker(block=False, secret=b"right-secret")
+    addr = f"{server.address[0]}:{server.address[1]}"
+    trials = HostTrials([addr], secret=b"wrong-secret", rpc_timeout=10.0)
+    t0 = time.monotonic()
+    try:
+        fmin(
+            "dss_ml_at_scale_tpu.hpo.objectives:quadratic",
+            {"x": hp.uniform("x", -10, 10)},
+            max_evals=4,
+            trials=trials,
+            rstate=np.random.default_rng(3),
+            return_argmin=False,
+        )
+    finally:
+        server.shutdown()
+    assert time.monotonic() - t0 < 20.0
+    assert all(
+        t["result"]["status"] == "fail"
+        and "auth failure" in t["result"]["error"]
+        for t in trials.trials
+    )
+
+
+def test_objective_faults_stay_permanent_fails():
+    # Site trial.evaluate (objective side) must NOT be transport-retried:
+    # the trial fails, the sweep survives, and no trial.evaluate retries
+    # are recorded for it.
+    server = serve_trial_worker(block=False)
+    addr = f"{server.address[0]}:{server.address[1]}"
+    plan = faults.install(FaultPlan.parse("trial.evaluate=2"))
+    retries_before = _counter("retry_total", site="trial.evaluate")
+    trials = HostTrials([addr])
+    try:
+        fmin(
+            "dss_ml_at_scale_tpu.hpo.objectives:quadratic",
+            {"x": hp.uniform("x", -10, 10)},
+            max_evals=6,
+            trials=trials,
+            rstate=np.random.default_rng(1),
+            return_argmin=False,
+        )
+    finally:
+        server.shutdown()
+    statuses = [t["result"]["status"] for t in trials.trials]
+    assert statuses.count("fail") == 2 and statuses.count(STATUS_OK) == 4
+    assert plan.stats()["trial.evaluate"]["fired"] == 2
+    assert _counter("retry_total", site="trial.evaluate") == retries_before
+
+
+# -- checkpoint integrity ----------------------------------------------------
+
+def test_manifest_roundtrip_and_corruption_detection(tmp_path):
+    step = tmp_path / "5"
+    (step / "default").mkdir(parents=True)
+    (step / "default" / "a.bin").write_bytes(b"x" * 1024)
+    (step / "meta.json").write_text("{}")
+    write_manifest(step)
+    assert (step / MANIFEST_NAME).exists()
+    assert verify_step(step) == ("intact", [])
+    # Same-size bitflip → checksum mismatch.
+    (step / "default" / "a.bin").write_bytes(b"y" + b"x" * 1023)
+    status, problems = verify_step(step)
+    assert status == "corrupt" and "checksum mismatch" in problems[0]
+    # Truncation → size mismatch; missing file → named.
+    (step / "default" / "a.bin").write_bytes(b"x" * 10)
+    assert "size 10" in verify_step(step)[1][0]
+    (step / "default" / "a.bin").unlink()
+    assert "missing file" in verify_step(step)[1][0]
+    # No manifest → unverified, never corrupt.
+    (step / MANIFEST_NAME).unlink()
+    assert verify_step(step) == ("unverified", [])
+
+
+def _tiny_task():
+    import optax
+
+    from dss_ml_at_scale_tpu.parallel import ClassifierTask
+    from test_models import tiny_resnet
+
+    return ClassifierTask(model=tiny_resnet(num_classes=4),
+                          tx=optax.adam(1e-2))
+
+
+def _fit(tmp_path, *, max_epochs, resume=False, steps_per_epoch=3,
+         val=False, keep=4, batches=None, task=None):
+    from dss_ml_at_scale_tpu.parallel import Trainer, TrainerConfig
+    from dss_ml_at_scale_tpu.runtime import make_mesh
+    from test_trainer import synthetic_batches
+
+    trainer = Trainer(
+        TrainerConfig(
+            max_epochs=max_epochs,
+            steps_per_epoch=steps_per_epoch,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            keep_checkpoints=keep,
+            limit_val_batches=2,
+            resume=resume,
+            log_every_steps=1000,
+        ),
+        mesh=make_mesh(),
+    )
+    return trainer.fit(
+        task if task is not None else _tiny_task(),
+        iter(batches if batches is not None
+             else synthetic_batches(steps_per_epoch * max_epochs)),
+        val_data_factory=(
+            (lambda: synthetic_batches(2, seed=7)) if val else None
+        ),
+    )
+
+
+def _corrupt_step(ckpt_dir: Path, step: int) -> Path:
+    """Flip bytes in the largest manifest-tracked file of a step."""
+    step_dir = ckpt_dir / str(step)
+    manifest = json.loads((step_dir / MANIFEST_NAME).read_text())
+    rel = max(manifest["files"], key=lambda r: manifest["files"][r]["bytes"])
+    target = step_dir / rel
+    target.write_bytes(b"\0" * manifest["files"][rel]["bytes"])
+    return target
+
+
+def test_trainer_saves_manifests_and_verify_cli_reports(tmp_path, capsys,
+                                                        devices8):
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    _fit(tmp_path, max_epochs=2)
+    ckpt = tmp_path / "ckpt"
+    steps = sorted(int(p.name) for p in ckpt.iterdir() if p.name.isdigit())
+    assert steps == [3, 6]
+    for s in steps:
+        assert verify_step(ckpt / str(s)) == ("intact", [])
+    assert main(["checkpoints", "verify", str(ckpt)]) == 0
+    out = capsys.readouterr().out
+    assert "step 6: intact" in out and "2 intact, 0 corrupt" in out
+
+    _corrupt_step(ckpt, 6)
+    assert main(["checkpoints", "verify", str(ckpt)]) == 1
+    out = capsys.readouterr().out
+    assert "step 6: corrupt" in out and "step 3: intact" in out
+    assert main(["checkpoints", "verify", str(tmp_path / "nope")]) == 2
+
+
+def test_restore_falls_back_past_corrupt_latest(tmp_path, devices8):
+    """Acceptance: corrupting the latest step on disk makes restore fall
+    back to the previous intact step (and count the fallback) instead of
+    raising."""
+    from dss_ml_at_scale_tpu.parallel import restore_state
+    from test_trainer import synthetic_batches
+
+    _fit(tmp_path, max_epochs=2)
+    ckpt = tmp_path / "ckpt"
+    _corrupt_step(ckpt, 6)
+
+    before = _counter("checkpoint_fallback_total")
+    # Library restore path: prefer=latest walks past the corrupt step 6.
+    state, used = restore_state(
+        _tiny_task(), synthetic_batches(1)[0], str(ckpt), prefer="latest"
+    )
+    assert used == 3 and int(state.step) == 3
+    assert _counter("checkpoint_fallback_total") - before == 1
+
+    # Trainer resume path: same fallback (max_epochs=1 → zero-epoch
+    # resume, so the restored step is observable directly).
+    r = _fit(tmp_path, max_epochs=1, resume=True)
+    assert int(r.state.step) == 3
+    assert _counter("checkpoint_fallback_total") - before == 2
+
+
+def test_resume_past_corrupt_step_resaves_that_step(tmp_path, devices8):
+    # Regression: the skipped corrupt step must be quarantined (renamed
+    # aside), or the resumed run would crash with "step already exists"
+    # when training re-reaches that step number and saves.
+    from test_trainer import synthetic_batches
+
+    task = _tiny_task()
+    _fit(tmp_path, max_epochs=2, task=task)  # saves steps 3 and 6
+    _corrupt_step(tmp_path / "ckpt", 6)
+    r2 = _fit(
+        tmp_path, max_epochs=2, resume=True, task=task,
+        batches=synthetic_batches(6),
+    )
+    # Fell back to 3, re-ran epoch 1, and RE-SAVED a fresh intact step 6.
+    assert int(r2.state.step) == 6
+    assert verify_step(tmp_path / "ckpt" / "6") == ("intact", [])
+    assert any(
+        p.name.startswith("6.corrupt")
+        for p in (tmp_path / "ckpt").iterdir()
+    ), "corrupt step was not quarantined"
+
+
+def test_restore_fault_injection_falls_back_without_disk_damage(
+    tmp_path, devices8
+):
+    # checkpoint.restore site: the first restore attempt (step 6) fails
+    # by injection; the walk falls back to step 3 even though the files
+    # on disk are fine.
+    plan = faults.install(FaultPlan.parse("checkpoint.restore=1"))
+    _fit(tmp_path, max_epochs=2)
+    r = _fit(tmp_path, max_epochs=1, resume=True)
+    assert int(r.state.step) == 3
+    assert plan.stats()["checkpoint.restore"]["fired"] == 1
+
+
+def test_pinned_corrupt_step_raises_instead_of_swapping_weights(
+    tmp_path, devices8
+):
+    from dss_ml_at_scale_tpu.parallel import restore_state
+    from test_trainer import synthetic_batches
+
+    _fit(tmp_path, max_epochs=2)
+    _corrupt_step(tmp_path / "ckpt", 6)
+    with pytest.raises(ValueError, match="integrity"):
+        restore_state(
+            _tiny_task(), synthetic_batches(1)[0],
+            str(tmp_path / "ckpt"), step=6,
+        )
+
+
+def test_save_fault_injection_fails_loudly(tmp_path, devices8):
+    # checkpoint.save faults must propagate — a training run that thinks
+    # it checkpointed but didn't is worse than one that stops.
+    faults.install(FaultPlan.parse("checkpoint.save=1"))
+    with pytest.raises(InjectedFault):
+        _fit(tmp_path, max_epochs=1)
+
+
+def test_resume_after_best_step_pruned_recovers_prior_best(
+    tmp_path, devices8
+):
+    # Satellite: keep_checkpoints=2 + an externally removed best step
+    # must not error on resume; _prior_best recovers from the metrics of
+    # the steps that remain and the run continues to completion.
+    import shutil
+
+    from test_trainer import synthetic_batches
+
+    r1 = _fit(tmp_path, max_epochs=2, val=True, keep=2)
+    assert r1.best_checkpoint_step is not None
+    shutil.rmtree(tmp_path / "ckpt" / str(r1.best_checkpoint_step))
+
+    r2 = _fit(
+        tmp_path, max_epochs=3, resume=True, val=True, keep=2,
+        batches=synthetic_batches(9),
+    )
+    assert int(r2.state.step) == 9
+    # The repeated epochs may legitimately re-create the deleted step
+    # number; what matters is the result points at a step that EXISTS.
+    assert r2.best_checkpoint_step in {3, 6, 9}
+    assert Path(r2.best_checkpoint_path).is_dir()
+
+
+# -- preemption --------------------------------------------------------------
+
+def test_sigterm_preempts_saves_and_resume_completes(tmp_path, devices8):
+    """Acceptance: SIGTERM mid-fit finishes the in-flight step, saves a
+    resumable checkpoint, returns preempted=True; fit(resume=True)
+    reaches the original final step exactly."""
+    from test_trainer import synthetic_batches
+
+    task = _tiny_task()
+    batches = synthetic_batches(10)
+
+    def firing_batches():
+        for i, b in enumerate(batches):
+            if i == 6:
+                os.kill(os.getpid(), signal.SIGTERM)
+            yield b
+
+    r1 = _fit(
+        tmp_path, max_epochs=2, steps_per_epoch=5,
+        batches=firing_batches(), task=task,
+    )
+    assert r1.preempted is True
+    stopped = int(r1.state.step)
+    assert 0 < stopped < 10
+    ckpt = tmp_path / "ckpt"
+    steps = sorted(int(p.name) for p in ckpt.iterdir() if p.name.isdigit())
+    assert stopped in steps
+    assert verify_step(ckpt / str(stopped))[0] == "intact"
+    # SIGTERM handling is restored after fit (the guard uninstalls).
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL, signal.Handlers.SIG_DFL
+    )
+
+    r2 = _fit(
+        tmp_path, max_epochs=2, steps_per_epoch=5, resume=True,
+        batches=synthetic_batches(10), task=task,
+    )
+    assert r2.preempted is False
+    assert int(r2.state.step) == 10  # the original final step, exactly
+
+
+def test_sigterm_preemption_survives_best_retention(tmp_path, devices8):
+    # Regression: with val metrics + best_fn retention (keep=2), a
+    # preemption save carrying a metrics dict would rank -inf and be
+    # pruned BY THE SAVE ITSELF — the preserved work gone before the
+    # process exits. The preemption save is metrics-less (exempt from
+    # best-ranking retention), so the step must survive to resume.
+    from test_trainer import synthetic_batches
+
+    task = _tiny_task()
+    batches = synthetic_batches(10)
+
+    def firing_batches():
+        for i, b in enumerate(batches):
+            if i == 8:
+                os.kill(os.getpid(), signal.SIGTERM)
+            yield b
+
+    r1 = _fit(
+        tmp_path, max_epochs=2, steps_per_epoch=5, val=True, keep=2,
+        batches=firing_batches(), task=task,
+    )
+    assert r1.preempted is True
+    stopped = int(r1.state.step)
+    assert stopped > 5  # epoch 0 completed (and saved); preempted mid-epoch 1
+    steps = {
+        int(p.name)
+        for p in (tmp_path / "ckpt").iterdir() if p.name.isdigit()
+    }
+    assert stopped in steps, "preemption checkpoint was pruned by retention"
+
+    r2 = _fit(
+        tmp_path, max_epochs=2, steps_per_epoch=5, resume=True, val=True,
+        keep=2, batches=synthetic_batches(10), task=task,
+    )
+    assert r2.preempted is False and int(r2.state.step) == 10
+
+
+# -- reader + training under an injected fault plan --------------------------
+
+def test_reader_retries_transient_faults(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from dss_ml_at_scale_tpu.data.reader import make_batch_reader
+
+    path = tmp_path / "t.parquet"
+    pq.write_table(
+        pa.table({"x": np.arange(100, dtype=np.int64)}), path,
+        row_group_size=10,
+    )
+    plan = faults.install(FaultPlan.parse("reader.next=2"))
+    before = _counter("retry_total", site="reader.next")
+    with make_batch_reader(
+        [str(path)], batch_size=10, num_epochs=1, shuffle_row_groups=False,
+    ) as reader:
+        rows = sum(len(b["x"]) for b in reader)
+    assert rows == 100  # every row arrived despite the injected faults
+    assert plan.stats()["reader.next"]["fired"] == 2
+    assert _counter("retry_total", site="reader.next") - before == 2
+
+
+def test_train_cli_completes_under_fault_plan(tmp_path, capsys, devices8):
+    """The tiny-training-run chaos test: `dsst train --fault-plan` with
+    transient reader faults completes the full run."""
+    import pyarrow as pa
+
+    from dss_ml_at_scale_tpu.config.cli import main
+    from dss_ml_at_scale_tpu.data import write_delta
+    from test_end_to_end import _jpeg
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 48)
+    table = pa.table({
+        "content": pa.array([_jpeg(rng, l) for l in labels], type=pa.binary()),
+        "label_index": pa.array(labels.astype(np.int64)),
+    })
+    data = tmp_path / "images"
+    write_delta(table, data, max_rows_per_file=16)
+
+    assert main([
+        "--fault-plan", "reader.next=2",
+        "train", "--data", str(data), "--model", "tiny",
+        "--num-classes", "4", "--crop", "64", "--batch-size", "16",
+        "--epochs", "1", "--learning-rate", "0.01", "--no-tracking",
+    ]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["steps"] == 3  # 48 rows // 16: full completion
+    assert summary["preempted"] is False
+    assert faults.active_plan().stats()["reader.next"]["fired"] == 2

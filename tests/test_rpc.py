@@ -150,10 +150,29 @@ def test_host_trials_failure_isolation(two_workers):
     assert all("blew up" in t["result"]["error"] for t in failed)
 
 
-def test_host_trials_unreachable_worker_fails_trials_not_sweep(two_workers):
-    # One live worker + one dead address: trials routed to the dead one
-    # fail individually; the sweep still completes and finds the optimum.
+def test_host_trials_unreachable_worker_retries_onto_live_one(two_workers):
+    # One live worker + one dead address: transport failures requeue the
+    # trial onto the surviving worker instead of consuming the eval (the
+    # PR-3 retry layer), so the sweep completes with every trial ok.
     trials = HostTrials([two_workers[0], "127.0.0.1:1"], rpc_timeout=2.0)
+    fmin(
+        "dss_ml_at_scale_tpu.hpo.objectives:quadratic",
+        {"x": hp.uniform("x", -10, 10)},
+        max_evals=10,
+        trials=trials,
+        rstate=np.random.default_rng(2),
+        return_argmin=False,
+    )
+    assert len(trials.trials) == 10
+    assert all(t["result"]["status"] == STATUS_OK for t in trials.trials)
+
+
+def test_host_trials_transport_retries_exhausted_fail_the_trial(two_workers):
+    # With no retries allowed, a trial that lands on the dead address
+    # fails permanently — the pre-retry behavior stays reachable.
+    trials = HostTrials(
+        [two_workers[0], "127.0.0.1:1"], rpc_timeout=2.0, max_retries=0
+    )
     fmin(
         "dss_ml_at_scale_tpu.hpo.objectives:quadratic",
         {"x": hp.uniform("x", -10, 10)},
