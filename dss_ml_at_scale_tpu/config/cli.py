@@ -23,12 +23,17 @@ def build_parser() -> argparse.ArgumentParser:
         "on some hosts, so this applies the in-process config update "
         "that actually sticks",
     )
+    # Site list generated from the one registry the tier-1 lint
+    # (scripts/check_fault_sites.py) holds the code to, so this help
+    # text cannot drift from the actual injection surface.
+    from ..resilience.faults import KNOWN_SITES
+
     parser.add_argument(
         "--fault-plan", default=None, metavar="SPEC",
         help="arm deterministic fault injection for this invocation, e.g. "
-        "'rpc.send=2;reader.next=p0.1;seed=7' (sites: rpc.send, "
-        "trial.evaluate, checkpoint.save, checkpoint.restore, "
-        "reader.next; N = fail the first N hits, pX = seeded per-hit "
+        "'rpc.send=2;grads.nonfinite=1@5;reader.next=p0.1;seed=7' "
+        f"(sites: {', '.join(sorted(KNOWN_SITES))}; N = fail the first N "
+        "hits, N@K = skip K hits then fail N, pX = seeded per-hit "
         "probability). Default: env DSST_FAULT_PLAN; chaos testing only",
     )
     sub = parser.add_subparsers(dest="command")

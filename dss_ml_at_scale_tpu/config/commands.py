@@ -458,6 +458,7 @@ def register_train(sub: argparse._SubParsersAction) -> None:
     tr.add_argument("--checkpoint-dir", default=None)
     tr.add_argument("--resume", action="store_true")
     tr.add_argument("--profile-dir", default=None)
+    _add_health_args(tr)
     _add_tracking_args(tr, "imagenet")
     tr.add_argument(
         "--coordinator", default=None,
@@ -606,6 +607,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
     if tracker is not None:
         tracker.log_params(_args_params(args))
 
+    health_cfg, quarantine = _health_config(args)
     trainer = Trainer(
         TrainerConfig(
             max_epochs=args.epochs,
@@ -615,6 +617,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
             resume=args.resume,
             profile_dir=args.profile_dir,
             shard_opt_state=args.shard_opt_state,
+            health=health_cfg,
         ),
         mesh=make_mesh(),
         tracker=tracker,
@@ -631,6 +634,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 cur_shard=topo.process_index, shard_count=topo.process_count,
             ).__enter__()
 
+    from ..resilience.health import TrainingHealthError
+
     with batch_loader(
         table,
         batch_size=args.batch_size,
@@ -640,10 +645,32 @@ def _cmd_train(args: argparse.Namespace) -> int:
         transform_spec=spec,
         cur_shard=topo.process_index,
         shard_count=topo.process_count,
+        # Under supervision, the reader tags every batch with its row
+        # provenance (so a discarded step quarantines exact rows),
+        # consults the blocklist, and survives corrupt samples by
+        # quarantining them instead of dying.
+        quarantine=quarantine,
+        emit_provenance=health_cfg is not None,
+        on_corrupt="quarantine" if health_cfg is not None else "raise",
     ) as train_reader:
-        result = trainer.fit(
-            task, train_reader, val_data_factory=val_factory, state=init_state
-        )
+        try:
+            result = trainer.fit(
+                task, train_reader, val_data_factory=val_factory,
+                state=init_state,
+            )
+        except TrainingHealthError as e:
+            # Operator-facing abort: a clean machine-parseable line (the
+            # bundle has the forensics), FAILED run status, exit 3.
+            fail_active_tracker()
+            print(json.dumps({
+                "aborted": True,
+                "reason": str(e),
+                "diagnostic_bundle": e.bundle_path,
+                "quarantine_file": (
+                    str(quarantine.path) if quarantine is not None else None
+                ),
+            }))
+            return 3
 
     last = result.history[-1] if result.history else {}
     # Epoch metrics were logged by the Trainer as they happened; the
@@ -667,6 +694,17 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 # True when a SIGTERM (spot/TPU-VM eviction) cut the run
                 # short; rerun with --resume to continue from the saved step.
                 "preempted": result.preempted,
+                # Health-supervisor accounting (0s with --health-policy off).
+                **(
+                    {
+                        "skipped_steps": result.skipped_steps,
+                        "health_rollbacks": result.health_rollbacks,
+                        "quarantined": (
+                            len(quarantine) if quarantine is not None else 0
+                        ),
+                    }
+                    if health_cfg is not None else {}
+                ),
             }
         )
     )
@@ -911,6 +949,7 @@ def register_lm(sub: argparse._SubParsersAction) -> None:
     )
     lm.add_argument("--checkpoint-dir", default=None)
     lm.add_argument("--resume", action="store_true")
+    _add_health_args(lm)
     _add_tracking_args(lm, "lm")
     lm.add_argument(
         "--coordinator", default=None,
@@ -988,6 +1027,7 @@ def _cmd_lm(args: argparse.Namespace) -> int:
         tracker.log_params(_args_params(args))
         tracker.log_params({"entropy_floor": floor})
 
+    health_cfg, quarantine = _health_config(args)
     trainer = Trainer(
         TrainerConfig(
             max_epochs=args.epochs,
@@ -995,24 +1035,38 @@ def _cmd_lm(args: argparse.Namespace) -> int:
             limit_val_batches=args.limit_val_batches,
             checkpoint_dir=args.checkpoint_dir,
             resume=args.resume,
+            health=health_cfg,
         ),
         mesh=mesh,
         tracker=tracker,
     )
+
+    from ..resilience.health import TrainingHealthError
 
     # Per-process sample seeds: every host draws a DISJOINT trajectory of
     # the SAME chain (the multi-host analogue of cur_shard/shard_count —
     # without it each process would train on identical batches and the
     # global batch would carry no extra information). Eval rides a third
     # seed range, shared across processes.
-    result = trainer.fit(
-        task,
-        token_batches(stream, sample_seed=args.seed + 1 + topo.process_index),
-        val_data_factory=lambda: token_batches(
-            stream, num_batches=args.limit_val_batches,
-            sample_seed=args.seed + 100_000,
-        ),
-    )
+    try:
+        result = trainer.fit(
+            task,
+            token_batches(
+                stream, sample_seed=args.seed + 1 + topo.process_index
+            ),
+            val_data_factory=lambda: token_batches(
+                stream, num_batches=args.limit_val_batches,
+                sample_seed=args.seed + 100_000,
+            ),
+        )
+    except TrainingHealthError as e:
+        fail_active_tracker()
+        print(json.dumps({
+            "aborted": True,
+            "reason": str(e),
+            "diagnostic_bundle": e.bundle_path,
+        }))
+        return 3
     _finish_tracker(tracker)
     last = result.history[-1] if result.history else {}
     summary = {
@@ -1023,6 +1077,9 @@ def _cmd_lm(args: argparse.Namespace) -> int:
         "entropy_floor_nats": round(floor, 4),
         "best_checkpoint": result.best_checkpoint_path,
     }
+    if args.health_policy != "off":
+        summary["skipped_steps"] = result.skipped_steps
+        summary["health_rollbacks"] = result.health_rollbacks
     if args.sample > 0:
         # KV-cached greedy decode from the trained weights; scored
         # against the TRUE chain (the generator is the fixture, so the
@@ -1286,6 +1343,70 @@ def _args_params(args: argparse.Namespace) -> dict:
     return {
         k: v for k, v in vars(args).items() if k not in skip and v is not None
     }
+
+
+def _add_health_args(parser) -> None:
+    """Training-health supervisor flags, shared by train and lm."""
+    parser.add_argument(
+        "--health-policy", choices=["off", "skip", "rollback", "abort"],
+        default="off",
+        help="supervise every train step with on-device non-finite "
+        "(loss/grad-norm isfinite) and EWMA loss-spike detection: a bad "
+        "update is discarded before commit and its batch quarantined; "
+        "past a --max-consecutive-skips streak, 'skip' aborts (a fully "
+        "poisoned stream must not spin) while 'rollback' restores the "
+        "newest intact checkpoint (then aborts after --max-rollbacks); "
+        "'abort' stops on the first bad step with a diagnostic bundle. "
+        "Default off (the unsupervised loop needs no per-step verdict "
+        "fetch)",
+    )
+    parser.add_argument(
+        "--spike-zscore", type=float, default=6.0,
+        help="loss-spike threshold: |loss - ewma_mean| > Z * ewma_std",
+    )
+    parser.add_argument(
+        "--health-warmup", type=int, default=20,
+        help="healthy steps observed before the spike detector arms "
+        "(non-finite detection is always armed)",
+    )
+    parser.add_argument(
+        "--max-consecutive-skips", type=int, default=3,
+        help="consecutive bad steps tolerated as skips; one more "
+        "escalates skip -> rollback (or abort)",
+    )
+    parser.add_argument(
+        "--max-rollbacks", type=int, default=2,
+        help="checkpoint rollbacks before the run aborts with a "
+        "diagnostic bundle",
+    )
+
+
+def _health_config(args: argparse.Namespace):
+    """``(HealthConfig | None, QuarantineList | None)`` from the flags.
+
+    The quarantine blocklist lives next to the checkpoints
+    (``<checkpoint_dir>/quarantine.jsonl``) so resume, replay, and
+    ``dsst quarantine`` all find it; without a checkpoint dir, bad
+    batches are still discarded and counted, just not persisted.
+    """
+    if getattr(args, "health_policy", "off") == "off":
+        return None, None
+    from ..resilience.health import HealthConfig
+    from ..resilience.rollback import QuarantineList
+
+    quarantine = None
+    if getattr(args, "checkpoint_dir", None):
+        quarantine = QuarantineList(
+            Path(args.checkpoint_dir) / "quarantine.jsonl"
+        )
+    return HealthConfig(
+        policy=args.health_policy,
+        spike_zscore=args.spike_zscore,
+        warmup_steps=args.health_warmup,
+        max_consecutive_skips=args.max_consecutive_skips,
+        max_rollbacks=args.max_rollbacks,
+        quarantine=quarantine,
+    ), quarantine
 
 
 def _resolve_lr_schedule(args: argparse.Namespace, meta: dict,
@@ -1574,6 +1695,66 @@ def _cmd_checkpoints_verify(args: argparse.Namespace) -> int:
     return 1 if counts["corrupt"] else 0
 
 
+def register_quarantine(sub: argparse._SubParsersAction) -> None:
+    qr = sub.add_parser(
+        "quarantine",
+        help="manage the poison-batch blocklist written by the training "
+        "health supervisor (rows excluded from replay/resume)",
+    )
+    qsub = qr.add_subparsers(dest="quarantine_cmd", required=True)
+
+    target_help = (
+        "a quarantine .jsonl file, or a checkpoint dir containing "
+        "quarantine.jsonl (where `dsst train --health-policy` writes it)"
+    )
+    ls = qsub.add_parser(
+        "list", help="print quarantined row ranges, one JSON line each"
+    )
+    ls.add_argument("target", help=target_help)
+    ls.set_defaults(fn=_cmd_quarantine_list)
+
+    cl = qsub.add_parser(
+        "clear",
+        help="drop every entry (the rows rejoin the next replay/resume)",
+    )
+    cl.add_argument("target", help=target_help)
+    cl.set_defaults(fn=_cmd_quarantine_clear)
+
+
+def _quarantine_target(target: str) -> Path:
+    p = Path(target)
+    return p / "quarantine.jsonl" if p.is_dir() else p
+
+
+def _cmd_quarantine_list(args: argparse.Namespace) -> int:
+    from ..resilience.rollback import QuarantineList
+
+    path = _quarantine_target(args.target)
+    if not path.exists():
+        print(f"no quarantine list at {path}")
+        return 1
+    q = QuarantineList(path)
+    rows = 0
+    for entry in q.entries:
+        rows += int(entry["row_hi"]) - int(entry["row_lo"])
+        print(json.dumps(entry))
+    print(f"{len(q)} entries, {rows} rows quarantined ({path})",
+          file=sys.stderr)
+    return 0
+
+
+def _cmd_quarantine_clear(args: argparse.Namespace) -> int:
+    from ..resilience.rollback import QuarantineList
+
+    path = _quarantine_target(args.target)
+    if not path.exists():
+        print(f"no quarantine list at {path}")
+        return 1
+    n = QuarantineList(path).clear()
+    print(f"cleared {n} entries from {path}")
+    return 0
+
+
 def register_runs(sub: argparse._SubParsersAction) -> None:
     rn = sub.add_parser(
         "runs",
@@ -1743,6 +1924,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_hpo(sub)
     register_trial_worker(sub)
     register_checkpoints(sub)
+    register_quarantine(sub)
     register_runs(sub)
     register_telemetry(sub)
     from .pipeline import register_pipeline

@@ -42,8 +42,13 @@ from typing import Iterator, Sequence
 import numpy as np
 import pyarrow.parquet as pq
 
-from ..resilience.faults import maybe_fail
+from ..resilience.faults import fault_fires, maybe_fail
 from ..resilience.retry import RetryPolicy, call_with_retry
+from ..resilience.rollback import (
+    PROVENANCE_KEY,
+    QuarantineList,
+    compress_rows,
+)
 from .sharding import RowGroupUnit, list_row_groups, shard_units
 from .transform import TransformSpec
 
@@ -82,7 +87,21 @@ class ParquetShardReader:
         seed: int = 0,
         reader_pool_type: str = "thread",
         drop_last: bool = True,
+        quarantine: "QuarantineList | str | None" = None,
+        emit_provenance: bool = False,
+        on_corrupt: str = "raise",
     ):
+        """``quarantine``: a poison-row blocklist (path or QuarantineList)
+        consulted at every iteration start — blocklisted rows are dropped
+        at load time, before decode, so a replay/resume never feeds them
+        again. ``emit_provenance``: tag each batch with the RowRanges
+        that built it (under ``_provenance``) so a training-health
+        supervisor can quarantine the exact rows behind a bad step.
+        ``on_corrupt="quarantine"``: a row whose decode/transform raises
+        is isolated (per-row retry of the failed group), counted on
+        ``corrupt_samples_total``, quarantined (when a list is
+        configured), and skipped — instead of killing the reader thread;
+        the default ``"raise"`` preserves fail-fast semantics."""
         if reader_pool_type not in ("thread", "dummy"):
             raise ValueError(
                 f"reader_pool_type must be 'thread' or 'dummy' (inline), "
@@ -90,6 +109,11 @@ class ParquetShardReader:
             )
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
+        if on_corrupt not in ("raise", "quarantine"):
+            raise ValueError(
+                f"on_corrupt must be 'raise' or 'quarantine', "
+                f"got {on_corrupt!r}"
+            )
         self._units = list_row_groups(list(paths))
         if len(self._units) < shard_count:
             raise ValueError(
@@ -108,6 +132,15 @@ class ParquetShardReader:
         self.seed = seed
         self.reader_pool_type = reader_pool_type
         self.drop_last = drop_last
+        self.emit_provenance = emit_provenance
+        self.on_corrupt = on_corrupt
+        self.quarantine = (
+            QuarantineList(quarantine)
+            if isinstance(quarantine, (str, bytes)) or hasattr(
+                quarantine, "__fspath__"
+            )
+            else quarantine
+        )
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._results: queue.Queue | None = None
@@ -146,7 +179,18 @@ class ParquetShardReader:
                 seed=self.seed,
             )
 
-    def _load_unit(self, unit: RowGroupUnit) -> dict[str, np.ndarray]:
+    def _load_unit(
+        self, unit: RowGroupUnit
+    ) -> tuple[dict[str, np.ndarray], np.ndarray]:
+        """Load + transform one row group → ``(cols, orig_rows)``.
+
+        ``orig_rows`` maps each surviving output row back to its
+        original row index within the group — the provenance spine.
+        Quarantined rows are dropped BEFORE decode (no cycles spent on
+        known-poison bytes); under ``on_corrupt="quarantine"`` a failing
+        transform is retried row-by-row to isolate, count, and
+        quarantine exactly the corrupt samples.
+        """
         # Fault-injection site: a transient failure here (or a real NFS
         # blip / truncated read below) is retried by the worker before it
         # gives up and fails the epoch — see _load_unit_with_retry.
@@ -164,11 +208,88 @@ class ParquetShardReader:
             name: _column_to_numpy(table.column(i))
             for i, name in enumerate(table.column_names)
         }
-        if self.transform_spec is not None:
-            cols = self.transform_spec(cols)
-        return cols
+        num_rows = len(next(iter(cols.values()))) if cols else 0
+        orig_rows = np.arange(num_rows, dtype=np.int64)
+        if self.quarantine is not None:
+            mask = self.quarantine.keep_mask(
+                unit.path, unit.row_group, num_rows
+            )
+            if mask is not None:
+                cols = {k: v[mask] for k, v in cols.items()}
+                orig_rows = orig_rows[mask]
+        if fault_fires("sample.corrupt"):
+            cols = _corrupt_first_sample(cols)
+        if self.transform_spec is not None and len(orig_rows):
+            try:
+                cols = self.transform_spec(cols)
+            except Exception:
+                if self.on_corrupt != "quarantine":
+                    raise
+                cols, orig_rows = self._isolate_corrupt_rows(
+                    unit, cols, orig_rows
+                )
+            else:
+                n_out = len(next(iter(cols.values()))) if cols else 0
+                if n_out != len(orig_rows):
+                    if self.emit_provenance or self.quarantine is not None:
+                        # Row-level provenance (and therefore quarantine
+                        # exclusion) is only meaningful for row-preserving
+                        # transforms; a filtering transform would silently
+                        # misattribute rows.
+                        raise ValueError(
+                            f"transform changed the row count "
+                            f"({len(orig_rows)} -> {n_out}) in {unit.path}"
+                            f"[rg={unit.row_group}]; provenance/quarantine "
+                            "require a row-preserving transform"
+                        )
+                    orig_rows = np.arange(n_out, dtype=np.int64)
+        return cols, orig_rows
 
-    def _load_unit_with_retry(self, unit: RowGroupUnit) -> dict[str, np.ndarray]:
+    def _isolate_corrupt_rows(
+        self, unit: RowGroupUnit, cols, orig_rows
+    ) -> tuple[dict[str, np.ndarray], np.ndarray]:
+        """Per-row transform of a failed group: good rows survive, each
+        corrupt row is counted, quarantined, and dropped — the reader
+        thread outlives isolated data corruption."""
+        from .. import telemetry
+
+        corrupt_counter = telemetry.counter(
+            "corrupt_samples_total",
+            "undecodable samples skipped (and quarantined) by the reader",
+        )
+        good: list[dict[str, np.ndarray]] = []
+        good_rows: list[int] = []
+        bad_rows: list[int] = []
+        last_error = "?"
+        for i in range(len(orig_rows)):
+            row = {k: v[i:i + 1] for k, v in cols.items()}
+            try:
+                good.append(self.transform_spec(row))
+                good_rows.append(int(orig_rows[i]))
+            except Exception as e:
+                bad_rows.append(int(orig_rows[i]))
+                last_error = f"{type(e).__name__}: {e}"
+        corrupt_counter.inc(len(bad_rows))
+        log.warning(
+            "reader: %d corrupt sample(s) in %s[rg=%d] skipped (last "
+            "error: %s)", len(bad_rows), unit.path, unit.row_group,
+            last_error,
+        )
+        if self.quarantine is not None and bad_rows:
+            self.quarantine.add(
+                compress_rows(unit.path, unit.row_group, bad_rows),
+                reason=f"undecodable sample ({last_error})",
+            )
+        if not good:
+            return {}, np.empty(0, np.int64)
+        out = {
+            k: np.concatenate([g[k] for g in good]) for k in good[0]
+        }
+        return out, np.asarray(good_rows, np.int64)
+
+    def _load_unit_with_retry(
+        self, unit: RowGroupUnit
+    ) -> tuple[dict[str, np.ndarray], np.ndarray]:
         # A flaky filesystem read should cost a short backoff, not the
         # whole epoch; semantic decode errors (bad bytes, schema
         # mismatch) are deterministic and fail immediately.
@@ -209,19 +330,21 @@ class ParquetShardReader:
                     unit = next(work, _SENTINEL)
                 if unit is _SENTINEL:
                     break
-                _put(self._load_unit_with_retry(unit))
+                _put((self._load_unit_with_retry(unit), unit))
         except BaseException as e:  # propagate to the consumer, don't die silently
             _put(_WorkerError(e))
         finally:
             _put(_SENTINEL)
 
-    def _row_groups(self) -> Iterator[dict[str, np.ndarray]]:
-        """Stream transformed row-group dicts, in arrival order."""
+    def _row_groups(
+        self,
+    ) -> Iterator[tuple[dict[str, np.ndarray], np.ndarray]]:
+        """Stream ``(cols, orig_rows)`` row groups, in arrival order."""
         if self.reader_pool_type == "dummy":
             for unit in self._unit_stream():
                 if self._stop.is_set():
                     return
-                yield self._load_unit_with_retry(unit)
+                yield self._load_unit_with_retry(unit), unit
             return
 
         self._results = results = queue.Queue(maxsize=self.results_queue_size)
@@ -281,18 +404,36 @@ class ParquetShardReader:
                 "reader is already being iterated; create a second reader "
                 "for concurrent streams"
             )
+        if self.quarantine is not None:
+            # Replay/resume semantics: a fresh iteration always sees the
+            # full blocklist, including rows quarantined by another
+            # process since this reader was built.
+            self.quarantine.refresh()
         self._stop.clear()
-        buf: list[dict[str, np.ndarray]] = []
+        # buf entries: (cols, unit_path, unit_row_group, orig_rows) —
+        # provenance rides the buffer so _take can slice it with the rows.
+        buf: list[tuple] = []
         buffered = 0
-        for group in self._row_groups():
-            buf.append(group)
+        for (group, orig_rows), unit in self._row_groups():
+            if not group or len(orig_rows) == 0:
+                continue  # fully quarantined / fully corrupt group
+            buf.append((group, unit.path, unit.row_group, orig_rows))
             buffered += _num_rows(group)
             while buffered >= self.batch_size:
-                batch, buf, buffered = _take(buf, self.batch_size)
-                yield batch
+                batch, prov, buf, buffered = _take(buf, self.batch_size)
+                yield self._finish_batch(batch, prov)
         if buffered and not self.drop_last:
-            batch, _, _ = _take(buf, buffered)
-            yield batch
+            batch, prov, _, _ = _take(buf, buffered)
+            yield self._finish_batch(batch, prov)
+
+    def _finish_batch(self, batch, prov) -> dict[str, np.ndarray]:
+        if self.emit_provenance:
+            batch[PROVENANCE_KEY] = [
+                r
+                for path, rg, rows in prov
+                for r in compress_rows(path, rg, rows)
+            ]
+        return batch
 
     def stop(self) -> None:
         self._stop.set()
@@ -320,23 +461,57 @@ def _num_rows(group: dict[str, np.ndarray]) -> int:
 
 
 def _take(buf, n):
-    """Split the buffered row groups into one n-row batch + remainder."""
+    """Split the buffered row groups into one n-row batch + remainder.
+
+    Buffer entries are ``(cols, path, row_group, orig_rows)``; the
+    returned ``prov`` mirrors the batch as ``(path, row_group,
+    taken_rows)`` triples so provenance slices exactly with the data.
+    """
     taken: dict[str, list[np.ndarray]] = {}
+    prov: list[tuple[str, int, np.ndarray]] = []
     need = n
-    rest: list[dict[str, np.ndarray]] = []
-    for group in buf:
+    rest: list[tuple] = []
+    for group, path, row_group, orig_rows in buf:
         if need == 0:
-            rest.append(group)
+            rest.append((group, path, row_group, orig_rows))
             continue
         rows = _num_rows(group)
         use = min(rows, need)
         for k, v in group.items():
             taken.setdefault(k, []).append(v[:use])
+        prov.append((path, row_group, orig_rows[:use]))
         if use < rows:
-            rest.append({k: v[use:] for k, v in group.items()})
+            rest.append((
+                {k: v[use:] for k, v in group.items()},
+                path, row_group, orig_rows[use:],
+            ))
         need -= use
     batch = {k: np.concatenate(v) if len(v) > 1 else v[0] for k, v in taken.items()}
-    return batch, rest, sum(_num_rows(g) for g in rest)
+    return batch, prov, rest, sum(_num_rows(g) for g, *_ in rest)
+
+
+def _corrupt_first_sample(cols: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """``sample.corrupt`` fault: truncate the first byte-valued cell.
+
+    Simulates a torn object-store read / bit-rotted record: downstream
+    decode raises on the short payload, exercising the per-row
+    isolation + quarantine path deterministically in tier-1. Datasets
+    with no byte column get a NaN poke in the first float cell instead.
+    """
+    for k, v in cols.items():
+        if v.dtype == object and len(v) and isinstance(
+            v[0], (bytes, bytearray)
+        ):
+            v = v.copy()
+            v[0] = bytes(v[0])[: max(1, len(v[0]) // 2)]
+            return {**cols, k: v}
+    for k, v in cols.items():
+        if np.issubdtype(v.dtype, np.floating) and len(v):
+            v = v.copy()
+            v[0] = np.nan
+            return {**cols, k: v}
+    log.warning("sample.corrupt fired but no corruptible column found")
+    return cols
 
 
 def _column_to_numpy(col) -> np.ndarray:

@@ -46,7 +46,16 @@ class Trials:
 
     @property
     def best_trial(self) -> dict:
-        ok = [t for t in self.trials if t["result"].get("status") == STATUS_OK]
+        # Finiteness guard on top of the status filter: NaN poisons
+        # min() comparisons (every comparison is False, so whichever
+        # trial happens to sit first "wins"), and results recorded by
+        # stores that bypass call_with_protocol must not crown a
+        # diverged trial.
+        ok = [
+            t for t in self.trials
+            if t["result"].get("status") == STATUS_OK
+            and _finite_loss(t["result"].get("loss"))
+        ]
         if not ok:
             raise ValueError("no successful trials")
         return min(ok, key=lambda t: t["result"]["loss"])
@@ -55,10 +64,13 @@ class Trials:
         return dict(self.best_trial["point"])
 
     def _history(self) -> list[tuple[dict, float]]:
+        # Same guard: a non-finite loss must not feed the TPE surrogate
+        # (tpe.suggest filters too — defense in depth across stores).
         return [
             (t["point"], t["result"]["loss"])
             for t in self.trials
             if t["result"].get("status") == STATUS_OK
+            and _finite_loss(t["result"].get("loss"))
         ]
 
     def _record(self, tid, point, result, t0) -> None:
@@ -82,6 +94,13 @@ class Trials:
             self._record(tid, point, result, t0)
             if tracker is not None:
                 _log_trial(tracker, tid, point, result)
+
+
+def _finite_loss(loss) -> bool:
+    try:
+        return loss is not None and np.isfinite(loss)
+    except TypeError:
+        return False
 
 
 def _call_objective(objective, space, point) -> dict:

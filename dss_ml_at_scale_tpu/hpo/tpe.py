@@ -30,9 +30,19 @@ class TPE:
         """Propose the next point.
 
         ``history``: sequence of ``(point_dict, loss)`` for completed
-        trials (failed trials excluded by the caller).
+        trials (failed trials excluded by the caller). Non-finite losses
+        are additionally dropped here: a single NaN would poison the
+        argsort that splits good/bad (NaN compares false with
+        everything, so the quantile split becomes arbitrary) and an Inf
+        would skew the split point — a diverged trial must not steer
+        the surrogate, whatever store produced the history.
         """
         params = iter_params(space)
+        history = [
+            (point, loss)
+            for point, loss in history
+            if loss is not None and math.isfinite(loss)
+        ]
         if len(history) < self.n_startup_trials:
             return {p.label: p.sample(rng) for p in params}
 

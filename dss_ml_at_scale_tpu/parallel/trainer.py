@@ -22,6 +22,7 @@ Semantics carried over from the reference driver:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import itertools
 import logging
@@ -41,8 +42,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import telemetry
 from ..data.prefetch import prefetch_to_mesh
 from ..resilience import checkpoint as integrity
+from ..resilience import health
 from ..resilience.faults import maybe_fail
 from ..resilience.preemption import PreemptionGuard
+from ..resilience.rollback import PROVENANCE_KEY
 from ..models.metrics import (
     cross_entropy_loss,
     multiclass_accuracy,
@@ -190,6 +193,10 @@ class ClassifierTask:
         metrics = {
             "train_loss": loss,
             "train_acc": multiclass_accuracy(logits, labels),
+            # Global grad-norm: a standard training-curve diagnostic,
+            # and one of the two fused health signals (with the loss)
+            # the health supervisor's isfinite reduction watches.
+            "grad_norm": optax.global_norm(grads),
         }
         return (
             TrainState(
@@ -281,7 +288,12 @@ class LMTask:
                 batch_stats=state.batch_stats,
                 opt_state=new_opt,
             ),
-            {"train_loss": loss, "train_ppl": jnp.exp(loss)},
+            {
+                "train_loss": loss,
+                "train_ppl": jnp.exp(loss),
+                # Health signal (see ClassifierTask.train_step).
+                "grad_norm": optax.global_norm(grads),
+            },
         )
 
     def eval_step(self, state: TrainState, batch: Batch):
@@ -327,6 +339,13 @@ class TrainerConfig:
     # {"tokens": P(None, "sp")} so batches shard the sequence dimension
     # and ring attention sees its expected layout.
     batch_specs: Mapping[str, Any] | None = None
+    # Training-health supervision (resilience.health.HealthConfig), or
+    # None (default) for the unsupervised loop — identical hot path to
+    # before, no per-step verdict fetch. With a config, every train step
+    # carries fused isfinite(loss/grad-norm) + EWMA loss-z-score signals
+    # on device, bad updates are discarded before commit, and the
+    # skip -> rollback -> abort policy ladder handles streaks.
+    health: Any = None
 
 
 @dataclasses.dataclass
@@ -340,6 +359,11 @@ class FitResult:
     # in-flight step finished and a resumable checkpoint was saved;
     # fit(resume=True) continues from exactly that step.
     preempted: bool = False
+    # Health-supervisor accounting (0 when TrainerConfig.health is None):
+    # updates discarded for non-finite signals / loss spikes, and
+    # checkpoint rollbacks performed.
+    skipped_steps: int = 0
+    health_rollbacks: int = 0
 
 
 class Trainer:
@@ -407,7 +431,7 @@ class Trainer:
         rng = rng if rng is not None else jax.random.key(0)
 
         train_iter = iter(train_data)
-        first = next(train_iter)
+        first, first_prov = _split_provenance(next(train_iter))
         # Examples per batch: the leading dim by default; tasks whose
         # batches aren't [batch, ...] (PipelinedTask: [n_micro, mb, ...])
         # declare a ``batch_size_of`` hook so steps/epoch and throughput
@@ -449,8 +473,28 @@ class Trainer:
                 )
         state = jax.device_put(state, state_shardings)
 
-        train_step = jax.jit(task.train_step, donate_argnums=0,
-                             out_shardings=(state_shardings, replicated))
+        supervisor = (
+            health.HealthSupervisor(cfg.health)
+            if cfg.health is not None else None
+        )
+        hstate = None
+        if supervisor is None:
+            train_step = jax.jit(task.train_step, donate_argnums=0,
+                                 out_shardings=(state_shardings, replicated))
+        else:
+            # Health-supervised step: the SAME task train_step with the
+            # on-device isfinite/z-score signals and the commit-or-
+            # discard select fused into the one jitted program. The tiny
+            # EWMA HealthState rides the carry, replicated.
+            h_shardings = jax.tree_util.tree_map(
+                lambda _: replicated, health.HealthState.create()
+            )
+            train_step = jax.jit(
+                health.guard_train_step(task.train_step, cfg.health),
+                donate_argnums=0,
+                out_shardings=((state_shardings, h_shardings), replicated),
+            )
+            hstate = jax.device_put(health.HealthState.create(), h_shardings)
         eval_step = jax.jit(task.eval_step, out_shardings=replicated)
 
         # Track-best only matters when something produces the metric.
@@ -459,59 +503,34 @@ class Trainer:
             cfg, use_best=val_data_factory is not None
         )
         start_epoch = 0
-        resume_offset = 0
         if manager is not None and cfg.resume and manager.latest_step() is not None:
             state = self._restore(manager, state)
-            # If the restore fell back past unusable newer steps, they
-            # must not stay registered: the run will re-reach those step
-            # numbers and manager.save would crash on "step already
-            # exists" (and the preemption-save gate would compare against
-            # a corrupt latest). Quarantine them aside and rebuild the
-            # manager so its step cache forgets them. (Process 0 renames,
-            # same discipline as manifest writes; single-host in CI.)
-            stale = [
-                s for s in manager.all_steps() if s > int(state.step)
-            ]
-            if stale:
-                if self.topology.process_index == 0:
-                    for s in stale:
-                        integrity.quarantine_step(
-                            Path(cfg.checkpoint_dir) / str(s)
-                        )
-                # Multi-host: no collective barrier here — instead every
-                # process waits (bounded) until process 0's renames are
-                # VISIBLE on the shared checkpoint FS before rebuilding
-                # its manager, so no rebuilt manager can still list a
-                # stale step. Single-host: the renames already happened
-                # synchronously above and the loop exits immediately.
-                deadline = time.monotonic() + 30.0
-                while time.monotonic() < deadline and any(
-                    (Path(cfg.checkpoint_dir) / str(s)).exists()
-                    for s in stale
-                ):
-                    time.sleep(0.2)
-                leftover = [
-                    s for s in stale
-                    if (Path(cfg.checkpoint_dir) / str(s)).exists()
-                ]
-                if leftover:
-                    log.warning(
-                        "stale checkpoint steps still visible after "
-                        "quarantine wait: %s — a later save of those step "
-                        "numbers may fail", leftover,
-                    )
-                manager = self._checkpoint_manager(
-                    cfg, use_best=val_data_factory is not None
-                )
+            manager = self._drop_stale_steps(
+                manager, cfg, int(state.step),
+                use_best=val_data_factory is not None,
+            )
             # A preemption checkpoint lands mid-epoch: the resumed first
-            # epoch runs only the REMAINING steps, so the final step
-            # count matches an uninterrupted run exactly.
+            # epoch runs only the REMAINING steps (the step-driven inner
+            # loop below), so the final step count matches an
+            # uninterrupted run exactly.
             start_epoch = int(state.step) // steps_per_epoch
-            resume_offset = int(state.step) % steps_per_epoch
+
+        # Batch provenance (reader-tagged RowRanges under _provenance) is
+        # host-side metadata: stripped before device transfer, queued in
+        # arrival order so the supervised loop can quarantine the exact
+        # rows behind a discarded step. prefetch_to_mesh preserves source
+        # order, so FIFO position n is device batch n.
+        prov_fifo: collections.deque = collections.deque()
 
         def batches():
+            if supervisor is not None:
+                prov_fifo.append(first_prov)
             yield first
-            yield from train_iter
+            for raw in train_iter:
+                b, prov = _split_provenance(raw)
+                if supervisor is not None:
+                    prov_fifo.append(prov)
+                yield b
 
         device_batches = prefetch_to_mesh(
             batches(), mesh, depth=cfg.prefetch_depth, specs=cfg.batch_specs
@@ -549,150 +568,214 @@ class Trainer:
         preempted = False
         guard = PreemptionGuard()
 
-        with guard:
-            for epoch in range(start_epoch, cfg.max_epochs):
-                if data_exhausted:
-                    log.warning(
-                        "train data exhausted at step %d; stopping before "
-                        "epoch %d of %d", step, epoch, cfg.max_epochs,
-                    )
-                    break
-                t0_wall = time.time()
-                t0 = time.perf_counter()
-                metrics = {}
-                epoch_steps = 0
-                steps_this_epoch = steps_per_epoch - (
-                    resume_offset if epoch == start_epoch else 0
-                )
-                for _ in range(steps_this_epoch):
-                    wait_t0 = time.perf_counter()
-                    try:
-                        batch = next(device_batches)
-                    except StopIteration:
-                        data_exhausted = True
-                        break
-                    wait_hist.observe(time.perf_counter() - wait_t0)
-                    if cfg.profile_dir is not None and not tracing and (
-                        step >= cfg.profile_start_step
-                    ):
-                        jax.profiler.start_trace(cfg.profile_dir)
-                        tracing = True
-                        trace_stop_at = step + cfg.profile_num_steps
-                    state, metrics = train_step(state, batch)
-                    epoch_steps += 1
-                    step += 1  # host-side mirror of state.step: no device sync
-                    step_timer.tick()
-                    compiles.update()
-                    if tracing and step >= trace_stop_at:
-                        jax.block_until_ready(state.params)
-                        jax.profiler.stop_trace()
-                        tracing = False
-                        cfg = dataclasses.replace(cfg, profile_dir=None)
-                    if step % cfg.log_every_steps == 0:
-                        self._log(
-                            {k: float(v) for k, v in metrics.items()}, step
+        try:
+            with guard:
+                for epoch in range(start_epoch, cfg.max_epochs):
+                    if data_exhausted:
+                        log.warning(
+                            "train data exhausted at step %d; stopping before "
+                            "epoch %d of %d", step, epoch, cfg.max_epochs,
                         )
-                    if guard.triggered:
                         break
-                if guard.triggered:
-                    # Preemption (SIGTERM): the in-flight step finished
-                    # above; save a resumable checkpoint NOW — mid-epoch —
-                    # and hand back a result marked preempted so the
-                    # caller's --resume continues from this exact step.
-                    preempted = True
-                    telemetry.counter(
-                        "preemption_signals_total",
-                        "preemption signals honored by Trainer.fit",
-                    ).inc()
+                    t0_wall = time.time()
+                    t0 = time.perf_counter()
+                    metrics = {}
+                    epoch_steps = 0
+                    # Step-driven (not iteration-driven) epoch boundary: the
+                    # epoch ends when `step` COMMITTED steps exist, so a
+                    # health-discarded update pulls a make-up batch instead
+                    # of silently shrinking the epoch (this is what makes a
+                    # poisoned run's update sequence identical to a clean run
+                    # whose reader excluded the poison rows), and a rollback
+                    # simply re-runs the restored span. Mid-epoch resume
+                    # falls out of the same arithmetic.
+                    epoch_end_step = (epoch + 1) * steps_per_epoch
+                    while step < epoch_end_step:
+                        wait_t0 = time.perf_counter()
+                        try:
+                            batch = next(device_batches)
+                        except StopIteration:
+                            data_exhausted = True
+                            break
+                        wait_hist.observe(time.perf_counter() - wait_t0)
+                        prov = (
+                            prov_fifo.popleft() if supervisor is not None else None
+                        )
+                        if cfg.profile_dir is not None and not tracing and (
+                            step >= cfg.profile_start_step
+                        ):
+                            jax.profiler.start_trace(cfg.profile_dir)
+                            tracing = True
+                            trace_stop_at = step + cfg.profile_num_steps
+                        if supervisor is None:
+                            state, metrics = train_step(state, batch)
+                            action = "commit"
+                        else:
+                            inject = supervisor.next_injection()
+                            (state, hstate), step_metrics = train_step(
+                                (state, hstate), batch, inject
+                            )
+                            # One scalar fetch: the verdict (and on a bad
+                            # step, the loss/z diagnostics). This is the
+                            # supervised loop's per-step metrics fetch; the
+                            # discard already happened on device.
+                            action = supervisor.observe(
+                                step + 1, step_metrics, prov
+                            )
+                            if action == "commit":
+                                metrics = step_metrics
+                        if action == "commit":
+                            epoch_steps += 1
+                            step += 1  # host-side mirror: no device sync
+                            step_timer.tick()
+                            compiles.update()
+                            if tracing and step >= trace_stop_at:
+                                jax.block_until_ready(state.params)
+                                jax.profiler.stop_trace()
+                                tracing = False
+                                cfg = dataclasses.replace(cfg, profile_dir=None)
+                            if step % cfg.log_every_steps == 0:
+                                self._log(
+                                    {k: float(v) for k, v in metrics.items()},
+                                    step,
+                                )
+                        elif action == "skip":
+                            # Update discarded on device; step not committed.
+                            # The executable still ran — keep compile
+                            # accounting honest.
+                            compiles.update()
+                        elif action == "rollback":
+                            state, hstate, manager, step = self._health_rollback(
+                                manager, cfg, state, h_shardings, supervisor,
+                                step + 1, use_best=val_data_factory is not None,
+                            )
+                            if best_step is not None and best_step > step:
+                                # The best step may have been rolled over
+                                # (quarantined aside as <step>.corrupt, or
+                                # itself the corruption that forced the
+                                # fallback) — re-derive from the steps the
+                                # rebuilt manager still holds, or
+                                # best_checkpoint_path would point at a
+                                # ghost.
+                                best_value, best_step = (
+                                    self._best_from_manager(manager, cfg)
+                                )
+                        else:  # abort
+                            raise supervisor.abort(
+                                step + 1,
+                                f"{supervisor.bad_streak} consecutive unhealthy "
+                                f"steps under policy {cfg.health.policy!r} "
+                                f"({supervisor.rollbacks}/"
+                                f"{cfg.health.max_rollbacks} rollbacks used)",
+                                cfg.checkpoint_dir,
+                            )
+                        if guard.triggered:
+                            break
+                    if guard.triggered:
+                        # Preemption (SIGTERM): the in-flight step finished
+                        # above; save a resumable checkpoint NOW — mid-epoch —
+                        # and hand back a result marked preempted so the
+                        # caller's --resume continues from this exact step.
+                        preempted = True
+                        telemetry.counter(
+                            "preemption_signals_total",
+                            "preemption signals honored by Trainer.fit",
+                        ).inc()
+                        jax.block_until_ready(state.params)
+                        latest = (
+                            manager.latest_step() if manager is not None else None
+                        )
+                        if manager is not None and step > (
+                            latest if latest is not None else -1
+                        ):
+                            # use_best=False deliberately: a metrics-carrying
+                            # save would rank -inf under best_fn retention and
+                            # orbax would prune the preemption step IMMEDIATELY
+                            # (verified against the installed version); a
+                            # metrics-less save is exempt from best-ranking
+                            # retention, so the preserved work survives until
+                            # --resume. synchronous: the eviction grace window
+                            # is the one place the trainer must not return
+                            # before the write (and its manifest) commit.
+                            self._save(
+                                manager, cfg, state, step,
+                                metric_val=None,
+                                use_best=False,
+                                synchronous=True,
+                            )
+                        log.warning(
+                            "preempted at step %d (epoch %d); resumable "
+                            "checkpoint %s", step, epoch,
+                            "saved" if manager is not None else
+                            "NOT saved (no checkpoint_dir)",
+                        )
+                        break
+                    if epoch_steps == 0:
+                        break
                     jax.block_until_ready(state.params)
-                    latest = (
-                        manager.latest_step() if manager is not None else None
+                    dt = time.perf_counter() - t0
+                    telemetry.get_span_log().record(
+                        "train_epoch", t0_wall, dt, epoch=epoch, steps=epoch_steps
                     )
-                    if manager is not None and step > (
-                        latest if latest is not None else -1
-                    ):
-                        # use_best=False deliberately: a metrics-carrying
-                        # save would rank -inf under best_fn retention and
-                        # orbax would prune the preemption step IMMEDIATELY
-                        # (verified against the installed version); a
-                        # metrics-less save is exempt from best-ranking
-                        # retention, so the preserved work survives until
-                        # --resume. synchronous: the eviction grace window
-                        # is the one place the trainer must not return
-                        # before the write (and its manifest) commit.
+                    images_per_sec = (
+                        epoch_steps
+                        * per_process_batch
+                        * self.topology.process_count
+                        / dt
+                    )
+                    throughput_gauge.set(images_per_sec)
+                    epoch_summary = {
+                        "epoch": epoch,
+                        "epoch_time_s": dt,
+                        "images_per_sec": images_per_sec,
+                        **step_timer.summary(),
+                        **{k: float(v) for k, v in metrics.items()},
+                    }
+                    step_timer.reset()
+
+                    if val_data_factory is not None:
+                        with telemetry.span("eval", epoch=epoch):
+                            epoch_summary.update(
+                                self._evaluate(eval_step, state, val_data_factory)
+                            )
+
+                    history.append(epoch_summary)
+                    self._log(
+                        {k: v for k, v in epoch_summary.items() if k != "epoch"},
+                        step,
+                    )
+                    if epoch_callback is not None:
+                        epoch_callback(dict(epoch_summary))
+
+                    metric_val = epoch_summary.get(cfg.best_metric)
+                    is_best = metric_val is not None and (
+                        best_value is None or sign * metric_val > sign * best_value
+                    )
+                    if is_best:
+                        best_value, best_step = metric_val, step
+                    if manager is not None:
                         self._save(
                             manager, cfg, state, step,
-                            metric_val=None,
-                            use_best=False,
-                            synchronous=True,
+                            metric_val=metric_val,
+                            use_best=val_data_factory is not None,
                         )
-                    log.warning(
-                        "preempted at step %d (epoch %d); resumable "
-                        "checkpoint %s", step, epoch,
-                        "saved" if manager is not None else
-                        "NOT saved (no checkpoint_dir)",
-                    )
-                    break
-                if epoch_steps == 0:
-                    break
+        finally:
+            # Teardown runs on EVERY exit, including a health abort
+            # (TrainingHealthError is an expected, caught-by-the-CLI
+            # exception): a live profiler trace must be closed and the
+            # in-flight async save + manifest finalizer joined, or the
+            # process continues with a truncated trace and a checkpoint
+            # whose manifest never lands.
+            if tracing:
                 jax.block_until_ready(state.params)
-                dt = time.perf_counter() - t0
-                telemetry.get_span_log().record(
-                    "train_epoch", t0_wall, dt, epoch=epoch, steps=epoch_steps
-                )
-                images_per_sec = (
-                    epoch_steps
-                    * per_process_batch
-                    * self.topology.process_count
-                    / dt
-                )
-                throughput_gauge.set(images_per_sec)
-                epoch_summary = {
-                    "epoch": epoch,
-                    "epoch_time_s": dt,
-                    "images_per_sec": images_per_sec,
-                    **step_timer.summary(),
-                    **{k: float(v) for k, v in metrics.items()},
-                }
-                step_timer.reset()
-
-                if val_data_factory is not None:
-                    with telemetry.span("eval", epoch=epoch):
-                        epoch_summary.update(
-                            self._evaluate(eval_step, state, val_data_factory)
-                        )
-
-                history.append(epoch_summary)
-                self._log(
-                    {k: v for k, v in epoch_summary.items() if k != "epoch"},
-                    step,
-                )
-                if epoch_callback is not None:
-                    epoch_callback(dict(epoch_summary))
-
-                metric_val = epoch_summary.get(cfg.best_metric)
-                is_best = metric_val is not None and (
-                    best_value is None or sign * metric_val > sign * best_value
-                )
-                if is_best:
-                    best_value, best_step = metric_val, step
-                if manager is not None:
-                    self._save(
-                        manager, cfg, state, step,
-                        metric_val=metric_val,
-                        use_best=val_data_factory is not None,
-                    )
-        if tracing:
-            jax.block_until_ready(state.params)
-            jax.profiler.stop_trace()
-        if manager is not None:
-            # Join the last step's manifest finalizer FIRST — it is
-            # itself inside manager.wait_until_finished(), which must not
-            # run concurrently with ours. It must land before callers
-            # read (or verify) the checkpoint dir.
-            self._join_manifest_writer()
-            manager.wait_until_finished()
-
+                jax.profiler.stop_trace()
+            if manager is not None:
+                # Join the last step's manifest finalizer FIRST — it is
+                # itself inside manager.wait_until_finished(), which must
+                # not run concurrently with ours. It must land before
+                # callers read (or verify) the checkpoint dir.
+                self._join_manifest_writer()
+                manager.wait_until_finished()
         return FitResult(
             state=state,
             best_checkpoint_step=best_step,
@@ -704,6 +787,12 @@ class Trainer:
                 else None
             ),
             preempted=preempted,
+            skipped_steps=(
+                supervisor.skipped_steps if supervisor is not None else 0
+            ),
+            health_rollbacks=(
+                supervisor.rollbacks if supervisor is not None else 0
+            ),
         )
 
     # -- eval -------------------------------------------------------------
@@ -767,6 +856,12 @@ class Trainer:
         """
         if manager is None or not cfg.resume:
             return None, None
+        return self._best_from_manager(manager, cfg)
+
+    def _best_from_manager(
+        self, manager, cfg: TrainerConfig
+    ) -> tuple[float | None, int | None]:
+        """Best (value, step) among the steps the manager still holds."""
         sign = 1.0 if cfg.best_mode == "max" else -1.0
         try:
             steps = set(manager.all_steps())
@@ -861,6 +956,89 @@ class Trainer:
     def _restore(self, manager, state: TrainState) -> TrainState:
         restored, _ = _restore_with_fallback(manager, _to_pytree(state))
         return TrainState(**restored)
+
+    def _drop_stale_steps(self, manager, cfg: TrainerConfig,
+                          restored_step: int, *, use_best: bool):
+        """Quarantine checkpoint steps newer than ``restored_step``.
+
+        After a fallback restore (corrupt latest on resume, or a health
+        rollback) the run will re-reach those step numbers, and
+        ``manager.save`` would crash on "step already exists" (and the
+        preemption-save gate would compare against a corrupt latest).
+        Rename them aside (``<step>.corrupt``) and rebuild the manager so
+        its step cache forgets them. Returns the (possibly rebuilt)
+        manager. (Process 0 renames, same discipline as manifest writes;
+        single-host in CI.)
+        """
+        stale = [s for s in manager.all_steps() if s > restored_step]
+        if not stale:
+            return manager
+        if self.topology.process_index == 0:
+            for s in stale:
+                integrity.quarantine_step(Path(cfg.checkpoint_dir) / str(s))
+        # Multi-host: no collective barrier here — instead every process
+        # waits (bounded) until process 0's renames are VISIBLE on the
+        # shared checkpoint FS before rebuilding its manager, so no
+        # rebuilt manager can still list a stale step. Single-host: the
+        # renames already happened synchronously above and the loop
+        # exits immediately.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and any(
+            (Path(cfg.checkpoint_dir) / str(s)).exists() for s in stale
+        ):
+            time.sleep(0.2)
+        leftover = [
+            s for s in stale
+            if (Path(cfg.checkpoint_dir) / str(s)).exists()
+        ]
+        if leftover:
+            log.warning(
+                "stale checkpoint steps still visible after quarantine "
+                "wait: %s — a later save of those step numbers may fail",
+                leftover,
+            )
+        return self._checkpoint_manager(cfg, use_best=use_best)
+
+    def _health_rollback(self, manager, cfg: TrainerConfig,
+                         state: TrainState, h_shardings,
+                         supervisor, at_step: int, *, use_best: bool):
+        """Policy-ladder rollback: restore the newest manifest-intact
+        checkpoint, reset the spike detector, free the rolled-over step
+        numbers. Returns ``(state, hstate, manager, step)``; escalates to
+        the supervisor's abort when no checkpoint can be restored."""
+        if manager is None:
+            raise supervisor.abort(
+                at_step,
+                "rollback requested but no checkpoint_dir is configured",
+                None,
+            )
+        t0_wall = time.time()
+        t0 = time.perf_counter()
+        # The in-flight manifest finalizer owns manager.wait_until_
+        # finished(); join it before driving the manager again.
+        self._join_manifest_writer()
+        manager.wait_until_finished()
+        try:
+            restored, rstep = _restore_with_fallback(
+                manager, _to_pytree(state)
+            )
+        except FileNotFoundError as e:
+            raise supervisor.abort(
+                at_step,
+                f"rollback found no intact checkpoint: {e}",
+                cfg.checkpoint_dir,
+            ) from e
+        state = TrainState(**restored)
+        # Fresh detector: the restored trajectory's loss level may differ
+        # from the EWMA the poisoned span accumulated.
+        hstate = jax.device_put(health.HealthState.create(), h_shardings)
+        manager = self._drop_stale_steps(
+            manager, cfg, rstep, use_best=use_best
+        )
+        supervisor.record_rollback(
+            at_step, rstep, t0_wall, time.perf_counter() - t0
+        )
+        return state, hstate, manager, rstep
 
     def _log(self, metrics: dict, step: int) -> None:
         if self.tracker is not None:
@@ -1009,6 +1187,20 @@ def restore_state(
         manager, _to_pytree(state), steps=order
     )
     return TrainState(**restored), used
+
+
+def _split_provenance(batch: Batch) -> tuple[Batch, Any]:
+    """Pop the reader's row-provenance side channel off a batch.
+
+    Provenance is host metadata (a list of RowRanges) — it must never
+    reach ``device_put``. Returned separately so the supervised loop can
+    quarantine the exact rows behind a discarded step; None for batches
+    without it (in-memory iterables, provenance-disabled readers).
+    """
+    if PROVENANCE_KEY in batch:
+        prov = batch[PROVENANCE_KEY]
+        return {k: v for k, v in batch.items() if k != PROVENANCE_KEY}, prov
+    return batch, None
 
 
 def _ocp():

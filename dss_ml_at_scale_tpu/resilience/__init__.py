@@ -21,30 +21,47 @@ equivalent for the seams Spark used to cover:
   the newest intact one instead of crashing the run.
 - :mod:`.preemption` — SIGTERM guard for the training loop: finish the
   in-flight step, save, return a resumable ``preempted`` result.
+- :mod:`.health` — training-health supervision: on-device
+  non-finite/loss-spike detection fused into the jitted train step,
+  discard-bad-update semantics, and the skip → rollback → abort policy
+  ladder (imported lazily by the Trainer — it needs jax, and this
+  package must stay importable from the CLI before backend selection).
+- :mod:`.rollback` — poison-batch bookkeeping: per-batch
+  :class:`~.rollback.RowRange` provenance and the JSONL
+  :class:`~.rollback.QuarantineList` blocklist the reader consults on
+  replay/resume (``dsst quarantine list|clear``).
 
 Recovery events meter themselves on the process telemetry registry:
 ``retry_total{site=}``, ``worker_readmitted_total``,
-``checkpoint_fallback_total``, ``faults_injected_total{site=}``.
+``checkpoint_fallback_total``, ``faults_injected_total{site=}``,
+``nonfinite_steps_total``, ``loss_spikes_total``,
+``health_rollbacks_total``, ``quarantined_batches_total``.
 """
 
 from __future__ import annotations
 
 from .checkpoint import MANIFEST_NAME, verify_checkpoint_dir, verify_step, write_manifest  # noqa: F401
-from .faults import FaultPlan, InjectedFault, active_plan, clear, install, install_from_spec, maybe_fail  # noqa: F401
+from .faults import KNOWN_SITES, FaultPlan, InjectedFault, active_plan, clear, fault_fires, install, install_from_spec, maybe_fail  # noqa: F401
 from .preemption import PreemptionGuard  # noqa: F401
 from .retry import RetryPolicy, call_with_retry, is_transient  # noqa: F401
+from .rollback import PROVENANCE_KEY, QuarantineList, RowRange  # noqa: F401
 from .workers import WorkerPool  # noqa: F401
 
 __all__ = [
     "FaultPlan",
     "InjectedFault",
+    "KNOWN_SITES",
     "MANIFEST_NAME",
+    "PROVENANCE_KEY",
     "PreemptionGuard",
+    "QuarantineList",
     "RetryPolicy",
+    "RowRange",
     "WorkerPool",
     "active_plan",
     "call_with_retry",
     "clear",
+    "fault_fires",
     "install",
     "install_from_spec",
     "is_transient",

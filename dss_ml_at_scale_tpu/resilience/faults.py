@@ -12,15 +12,29 @@ site check is one global read and a ``None`` comparison.
 Plan spec grammar (semicolon-separated entries)::
 
     rpc.send.evaluate=2          # fail the first 2 hits of this site
+    grads.nonfinite=1@5          # skip the first 5 hits, fail the next 1
     reader.next=p0.25            # fail each hit with probability 0.25
     checkpoint.restore=1;seed=7  # seed the probability draws
+
+``N@K`` targets a specific occurrence — "poison exactly training step
+K" — which is how the health-supervisor chaos tests make a fault land
+on a chosen batch deterministically.
 
 Site names are dotted paths; a spec entry matches a checked site when it
 is equal to it or a dotted prefix of it (``rpc.send`` arms
 ``rpc.send.evaluate`` and ``rpc.send.ping``; the most specific entry
 wins). Injected failures raise :class:`InjectedFault`, a
 ``ConnectionError`` subclass so the transport-failure classifiers treat
-it exactly like a real dead peer.
+it exactly like a real dead peer. Sites that corrupt *values* instead of
+raising (a NaN gradient is not an exception) poll :func:`fault_fires`,
+which consumes a hit and returns a bool; the call site applies its own
+corruption.
+
+Every site name used anywhere in the package must appear in
+:data:`KNOWN_SITES` — ``scripts/check_fault_sites.py`` (tier-1) fails
+when an undeclared site creeps in or a declared site loses its last
+call site, so the injection surface cannot silently drift from the
+docs and the ``--fault-plan`` CLI help (generated from this dict).
 """
 
 from __future__ import annotations
@@ -31,9 +45,28 @@ import random
 import threading
 import zlib
 
-from .. import telemetry
-
 log = logging.getLogger(__name__)
+
+# The fault-injection surface: site name -> what arming it simulates.
+# scripts/check_fault_sites.py keeps this in lockstep with the package's
+# maybe_fail()/fault_fires() call sites; cli.py renders the keys into
+# the --fault-plan help text.
+KNOWN_SITES = {
+    "rpc.send": "transport failure sending an RPC (suffix .<method>: "
+                "evaluate, ping, ...)",
+    "trial.evaluate": "an HPO objective raising mid-trial (permanent, "
+                      "never transport-retried)",
+    "checkpoint.save": "a checkpoint write failing before commit",
+    "checkpoint.restore": "a checkpoint restore raising (damage the "
+                          "manifest cannot see)",
+    "reader.next": "a transient IO failure loading a Parquet row group",
+    "sample.corrupt": "undecodable sample bytes inside a row group "
+                      "(truncated image, bad row)",
+    "grads.nonfinite": "a NaN/Inf gradient step (poisons the train "
+                       "step's loss/grad-norm health signals)",
+    "loss.spike": "a loss spike far outside the EWMA band on one "
+                  "train step",
+}
 
 
 class InjectedFault(ConnectionError):
@@ -46,7 +79,8 @@ class _Site:
 
     count: int | None = None      # exact-count mode: fail the next N hits
     probability: float = 0.0      # probability mode: seeded per-hit draw
-    hits: int = 0                 # matching maybe_fail() calls observed
+    skip: int = 0                 # N@K mode: hits to pass before firing
+    hits: int = 0                 # matching check()/fires() calls observed
     fired: int = 0                # faults actually raised
 
 
@@ -86,10 +120,14 @@ class FaultPlan:
                     )
                 sites[name] = _Site(probability=p)
             else:
-                n = int(value)
-                if n < 0:
-                    raise ValueError(f"fault count must be >= 0, got {entry!r}")
-                sites[name] = _Site(count=n)
+                count_s, at, skip_s = value.partition("@")
+                n = int(count_s)
+                skip = int(skip_s) if at else 0
+                if n < 0 or skip < 0:
+                    raise ValueError(
+                        f"fault count/offset must be >= 0, got {entry!r}"
+                    )
+                sites[name] = _Site(count=n, skip=skip)
         plan = cls(sites, seed=seed)
         return plan
 
@@ -103,17 +141,19 @@ class FaultPlan:
             probe, _, _ = probe.rpartition(".")
         return None
 
-    def check(self, site: str) -> None:
-        """Raise :class:`InjectedFault` if the plan arms this hit."""
+    def _consume(self, site: str) -> bool:
+        """Advance the matching entry's state for one hit; True = fire."""
         with self._lock:
             hit = self._match(site)
             if hit is None:
-                return
+                return False
             name, armed = hit
             armed.hits += 1
             fire = False
             if armed.count is not None:
-                if armed.count > 0:
+                if armed.skip > 0:
+                    armed.skip -= 1
+                elif armed.count > 0:
                     armed.count -= 1
                     fire = True
             elif armed.probability > 0.0:
@@ -128,12 +168,34 @@ class FaultPlan:
             if fire:
                 armed.fired += 1
         if fire:
+            # Local import: the CLI imports this module for KNOWN_SITES
+            # while building its parser, before telemetry is needed.
+            from .. import telemetry
+
             telemetry.counter(
                 "faults_injected_total", "faults raised by the active "
                 "FaultPlan", labels=("site",),
             ).labels(site=name).inc()
+        return fire
+
+    def check(self, site: str) -> None:
+        """Raise :class:`InjectedFault` if the plan arms this hit."""
+        if self._consume(site):
             log.warning("fault plan: injecting fault at site %r", site)
             raise InjectedFault(f"injected fault at site {site!r}")
+
+    def fires(self, site: str) -> bool:
+        """Consume one hit; True when the call site should self-corrupt.
+
+        The non-raising twin of :meth:`check` for sites where the
+        failure mode is a *bad value*, not an exception (non-finite
+        gradients, corrupt sample bytes): the caller applies its own
+        corruption when this returns True.
+        """
+        if self._consume(site):
+            log.warning("fault plan: arming value fault at site %r", site)
+            return True
+        return False
 
     def stats(self) -> dict[str, dict[str, int]]:
         """Per-entry ``{"hits": n, "fired": n}`` — what tests assert on."""
@@ -173,3 +235,8 @@ def maybe_fail(site: str) -> None:
     """The site marker production code calls; no-op unless a plan is armed."""
     if _plan is not None:
         _plan.check(site)
+
+
+def fault_fires(site: str) -> bool:
+    """Value-corruption site marker: False (no-op) unless a plan arms it."""
+    return _plan is not None and _plan.fires(site)

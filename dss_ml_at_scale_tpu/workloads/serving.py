@@ -59,6 +59,18 @@ from ..serving import (
 )
 
 
+class NonFiniteScoreError(RuntimeError):
+    """The compiled scorer produced NaN/Inf outputs.
+
+    A server-side fault (corrupt checkpoint weights, an XLA numeric
+    bug, poisoned batch-norm statistics) — never the client's input —
+    so it maps to HTTP 500 via the handler's server-fault arm, counted
+    on ``scoring_nonfinite_total``. Without this guard the NaN would be
+    serialized as JSON ``NaN``, which most clients reject as invalid
+    JSON *after* the 200 status already went out.
+    """
+
+
 class Predictor:
     """Checkpoint → compiled fixed-batch scorer.
 
@@ -178,6 +190,20 @@ class Predictor:
             idx, prob = self._score(jnp.asarray(chunk))
             # One host fetch per output per chunk, not per image.
             idx, prob = np.asarray(idx), np.asarray(prob)
+            # Non-finite guard: only the REAL rows count (padding rows
+            # score garbage by design). Fail the request (500) rather
+            # than hand clients NaN probabilities.
+            bad = int((~np.isfinite(prob[:n])).sum())
+            if bad:
+                telemetry.counter(
+                    "scoring_nonfinite_total",
+                    "scored images rejected for non-finite "
+                    "probabilities (HTTP 500, never serialized)",
+                ).inc(bad)
+                raise NonFiniteScoreError(
+                    f"{bad} non-finite probabilities from the compiled "
+                    f"scorer (checkpoint step {self.step})"
+                )
             for i in range(n):
                 k = int(idx[i])
                 row = {"pred_index": k, "pred_prob": float(prob[i])}

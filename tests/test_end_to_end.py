@@ -89,3 +89,65 @@ def test_end_to_end_training_slice(devices8, image_delta_table):
     ), result.history
     assert "val_acc" in result.history[-1]
     assert result.history[-1]["images_per_sec"] > 0
+
+
+def test_end_to_end_health_rollback_parity(devices8, image_delta_table, tmp_path):
+    """The PR-4 acceptance slice with the REAL reader in the loop: a
+    grads.nonfinite fault injected at step 2 under --health-policy
+    rollback discards the update and quarantines the batch's rows; a
+    clean replay whose reader consults the blocklist produces
+    bitwise-identical final params. Row-exact reader exclusion + the
+    on-device discard select are what make the two runs see the same
+    update sequence."""
+    from dss_ml_at_scale_tpu.resilience import FaultPlan, QuarantineList, faults
+    from dss_ml_at_scale_tpu.resilience.health import HealthConfig
+
+    dt = DeltaTable(image_delta_table)
+    mesh = make_mesh()
+    spec = imagenet_transform_spec(crop=64)
+    quarantine_file = tmp_path / "quarantine.jsonl"
+
+    def run(*, poison: bool):
+        task = ClassifierTask(
+            model=tiny_resnet(num_classes=4), tx=optax.adam(1e-2)
+        )
+        health = HealthConfig(
+            policy="rollback", quarantine=QuarantineList(quarantine_file)
+        )
+        trainer = Trainer(
+            TrainerConfig(
+                max_epochs=1, steps_per_epoch=4, log_every_steps=100,
+                health=health,
+            ),
+            mesh=mesh,
+        )
+        if poison:
+            faults.install(FaultPlan.parse("grads.nonfinite=1@1"))
+        try:
+            # One worker + no shuffle: deterministic arrival order, so
+            # the two runs' surviving row streams align batch-for-batch.
+            with batch_loader(
+                dt, batch_size=16, num_epochs=None, workers_count=1,
+                transform_spec=spec, shuffle_row_groups=False,
+                quarantine=QuarantineList(quarantine_file),
+                emit_provenance=True, on_corrupt="quarantine",
+            ) as reader:
+                return trainer.fit(task, reader)
+        finally:
+            faults.clear()
+
+    poisoned = run(poison=True)
+    assert int(poisoned.state.step) == 4 and poisoned.skipped_steps == 1
+    q = QuarantineList(quarantine_file)
+    assert len(q) == 1
+    assert q.entries[0]["row_hi"] - q.entries[0]["row_lo"] == 16
+
+    clean = run(poison=False)  # reader consults the blocklist on replay
+    assert int(clean.state.step) == 4 and clean.skipped_steps == 0
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(poisoned.state.params),
+        jax.tree_util.tree_leaves(clean.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

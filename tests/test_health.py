@@ -1,0 +1,331 @@
+"""Training-health supervisor chaos suite (PR 4).
+
+The property that matters is *deterministic rollback parity*: a run that
+hits an injected NaN-gradient step under the health supervisor must
+finish with BITWISE-identical parameters to a clean run that simply
+never saw the poison batch — the discard select on device is exact, the
+skipped step pulls a make-up batch, and the quarantine blocklist makes
+the exclusion durable across replay/resume.
+"""
+
+import json
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from dss_ml_at_scale_tpu import telemetry
+from dss_ml_at_scale_tpu.hpo import STATUS_FAIL, STATUS_OK, TPE, fmin, hp
+from dss_ml_at_scale_tpu.hpo.fmin import Trials
+from dss_ml_at_scale_tpu.parallel import ClassifierTask, Trainer, TrainerConfig
+from dss_ml_at_scale_tpu.resilience import FaultPlan, QuarantineList, RowRange, faults
+from dss_ml_at_scale_tpu.resilience.health import (
+    HealthConfig,
+    TrainingHealthError,
+)
+from dss_ml_at_scale_tpu.runtime import make_mesh
+
+from test_models import tiny_resnet
+from test_trainer import synthetic_batches
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+def _counter(name, **labels):
+    for m in telemetry.snapshot()["metrics"]:
+        if m["name"] == name and (m.get("labels") or {}) == labels:
+            return m["value"]
+    return 0.0
+
+
+def _task():
+    return ClassifierTask(model=tiny_resnet(num_classes=4), tx=optax.adam(1e-2))
+
+
+def _fit(batches, health, **cfg):
+    trainer = Trainer(
+        TrainerConfig(log_every_steps=1000, health=health, **cfg),
+        mesh=make_mesh(),
+    )
+    return trainer.fit(_task(), iter([dict(b) for b in batches]))
+
+
+def _assert_params_equal(a, b):
+    for x, y in zip(
+        jax.tree_util.tree_leaves(a.state.params),
+        jax.tree_util.tree_leaves(b.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- fault-plan grammar additions -------------------------------------------
+
+def test_fault_plan_skip_offset_targets_a_specific_hit():
+    plan = faults.install(FaultPlan.parse("grads.nonfinite=1@3"))
+    fired = [faults.fault_fires("grads.nonfinite") for _ in range(6)]
+    assert fired == [False, False, False, True, False, False]
+    assert plan.stats()["grads.nonfinite"] == {"hits": 6, "fired": 1}
+
+
+def test_fault_fires_is_noop_disarmed_and_meters_when_armed():
+    faults.clear()
+    assert faults.fault_fires("grads.nonfinite") is False
+    before = _counter("faults_injected_total", site="loss.spike")
+    faults.install(FaultPlan.parse("loss.spike=1"))
+    assert faults.fault_fires("loss.spike") is True
+    assert _counter("faults_injected_total", site="loss.spike") - before == 1
+
+
+def test_fault_plan_rejects_bad_skip_offset():
+    for bad in ("a=1@-2", "a=1@x", "a=@3"):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+
+# -- the acceptance property: deterministic rollback parity ------------------
+
+def test_rollback_policy_nonfinite_step_matches_clean_run(devices8):
+    """grads.nonfinite injected at step 4 under --health-policy rollback:
+    the run completes, and final params are bitwise-identical to a clean
+    run trained without the poison batch (the skip rung of the ladder:
+    on-device discard + make-up batch)."""
+    batches = synthetic_batches(10)
+    before = _counter("nonfinite_steps_total")
+
+    faults.install(FaultPlan.parse("grads.nonfinite=1@3"))
+    poisoned = _fit(
+        batches, HealthConfig(policy="rollback"),
+        max_epochs=2, steps_per_epoch=4,
+    )
+    faults.clear()
+    clean = _fit(
+        [b for i, b in enumerate(batches) if i != 3],
+        HealthConfig(policy="rollback"),
+        max_epochs=2, steps_per_epoch=4,
+    )
+
+    assert int(poisoned.state.step) == 8 == int(clean.state.step)
+    assert poisoned.skipped_steps == 1 and poisoned.health_rollbacks == 0
+    assert _counter("nonfinite_steps_total") - before == 1
+    _assert_params_equal(poisoned, clean)
+
+
+def test_skip_policy_discards_spike_and_matches_clean_run(devices8):
+    """The EWMA z-score detector: a loss spike injected after warmup is
+    discarded under policy=skip, with the same clean-run parity."""
+    batches = synthetic_batches(10)
+    health = HealthConfig(policy="skip", warmup_steps=3, spike_zscore=6.0)
+    before = _counter("loss_spikes_total")
+
+    faults.install(FaultPlan.parse("loss.spike=1@5"))
+    poisoned = _fit(batches, health, max_epochs=2, steps_per_epoch=4)
+    faults.clear()
+    clean = _fit(
+        [b for i, b in enumerate(batches) if i != 5],
+        health, max_epochs=2, steps_per_epoch=4,
+    )
+
+    assert int(poisoned.state.step) == 8 == int(clean.state.step)
+    assert poisoned.skipped_steps == 1
+    assert _counter("loss_spikes_total") - before == 1
+    _assert_params_equal(poisoned, clean)
+
+
+def test_quarantine_records_discarded_batch_provenance(devices8, tmp_path):
+    """A discarded batch's provenance lands on the JSONL blocklist (and
+    the quarantined_batches_total counter)."""
+    q = QuarantineList(tmp_path / "quarantine.jsonl")
+    batches = [dict(b) for b in synthetic_batches(6)]
+    for i, b in enumerate(batches):
+        b["_provenance"] = [RowRange("mem://train", i, 0, 16)]
+    before = _counter("quarantined_batches_total")
+
+    faults.install(FaultPlan.parse("grads.nonfinite=1@2"))
+    result = _fit(
+        batches,
+        HealthConfig(policy="skip", quarantine=q),
+        max_epochs=1, steps_per_epoch=4,
+    )
+    assert int(result.state.step) == 4 and result.skipped_steps == 1
+    assert _counter("quarantined_batches_total") - before == 1
+    assert len(q) == 1
+    entry = q.entries[0]
+    assert entry["row_group"] == 2 and "nonfinite" in entry["reason"]
+    # ...and a fresh QuarantineList reads the same entry back from disk.
+    assert len(QuarantineList(tmp_path / "quarantine.jsonl")) == 1
+
+
+# -- the rollback + abort rungs ---------------------------------------------
+
+def test_rollback_restores_checkpoint_then_aborts_after_budget(
+    devices8, tmp_path
+):
+    """A persistent fault: skip, skip, rollback to the newest intact
+    checkpoint, skip, skip, then abort with a diagnostic bundle once
+    max_rollbacks is spent."""
+    ckpt = tmp_path / "ckpt"
+    health = HealthConfig(
+        policy="rollback", max_consecutive_skips=1, max_rollbacks=1,
+    )
+    rb_before = _counter("health_rollbacks_total")
+    nf_before = _counter("nonfinite_steps_total")
+
+    faults.install(FaultPlan.parse("grads.nonfinite=100@4"))
+    with pytest.raises(TrainingHealthError) as exc_info:
+        _fit(
+            synthetic_batches(14), health,
+            max_epochs=3, steps_per_epoch=2, checkpoint_dir=str(ckpt),
+        )
+
+    assert _counter("health_rollbacks_total") - rb_before == 1
+    # skip, skip(->rollback), skip, skip(->abort): 4 discarded updates.
+    assert _counter("nonfinite_steps_total") - nf_before == 4
+    err = exc_info.value
+    assert err.bundle_path is not None
+    bundle = json.loads((tmp_path / "ckpt" / "health_abort_step5.json").read_text())
+    assert bundle["rollbacks"] == 1 and bundle["policy"] == "rollback"
+    assert bundle["recent_incidents"][-1]["verdict"] == "nonfinite"
+    assert bundle["fault_plan_stats"]["grads.nonfinite"]["fired"] == 4
+    # The intact checkpoints survived (steps 2 and 4 from epochs 0/1).
+    assert (ckpt / "4").is_dir()
+
+
+def test_abort_policy_stops_on_first_bad_step(devices8):
+    faults.install(FaultPlan.parse("grads.nonfinite=1@1"))
+    with pytest.raises(TrainingHealthError):
+        _fit(
+            synthetic_batches(6), HealthConfig(policy="abort"),
+            max_epochs=1, steps_per_epoch=4,
+        )
+
+
+def test_rollback_without_checkpoint_dir_aborts(devices8):
+    faults.install(FaultPlan.parse("grads.nonfinite=100"))
+    with pytest.raises(TrainingHealthError, match="no checkpoint_dir"):
+        _fit(
+            synthetic_batches(8),
+            HealthConfig(policy="rollback", max_consecutive_skips=1),
+            max_epochs=1, steps_per_epoch=4,
+        )
+
+
+def test_health_counters_render_on_metrics_exposition(devices8):
+    """The acceptance counters are registered (visible on /metrics and in
+    the archived `dsst telemetry` snapshot) as soon as a supervised fit
+    runs, even before any incident."""
+    _fit(synthetic_batches(4), HealthConfig(policy="skip"),
+         max_epochs=1, steps_per_epoch=2)
+    text = telemetry.render_prometheus()
+    for name in ("nonfinite_steps_total", "loss_spikes_total",
+                 "health_rollbacks_total", "quarantined_batches_total"):
+        assert name in text
+
+
+# -- serving satellite: non-finite score guard ------------------------------
+
+def test_serving_score_rejects_nonfinite_probabilities():
+    import jax.numpy as jnp
+
+    from dss_ml_at_scale_tpu.workloads.serving import (
+        NonFiniteScoreError,
+        Predictor,
+    )
+
+    p = object.__new__(Predictor)
+    p.micro_batch, p.label_names, p.step = 4, None, 7
+    p._np, p._jnp = np, jnp
+    p._predict_hist = telemetry.histogram("predict_batch_seconds")
+    p._predict_images = telemetry.counter("predict_images_total")
+    p._predict_errors = telemetry.counter("predict_errors_total")
+    p._score = lambda x: (
+        jnp.zeros(4, jnp.int32), jnp.full((4,), jnp.nan, jnp.float32)
+    )
+    before = _counter("scoring_nonfinite_total")
+    err_before = _counter("predict_errors_total")
+    with pytest.raises(NonFiniteScoreError, match="non-finite"):
+        p.score(np.zeros((2, 8, 8, 3), np.float32))
+    # Only the 2 REAL rows count — padding rows are garbage by design.
+    assert _counter("scoring_nonfinite_total") - before == 2
+    assert _counter("predict_errors_total") - err_before == 1
+
+    # Finite scores still flow.
+    p._score = lambda x: (
+        jnp.zeros(4, jnp.int32), jnp.full((4,), 0.5, jnp.float32)
+    )
+    assert [r["pred_prob"] for r in p.score(
+        np.zeros((2, 8, 8, 3), np.float32)
+    )] == [0.5, 0.5]
+
+
+# -- HPO satellite: non-finite objectives fail their trial -------------------
+
+def test_nonfinite_objective_is_failed_trial_and_best_is_finite():
+    space = {"x": hp.uniform("x", 0.0, 10.0)}
+
+    def objective(args):
+        # Half the space diverges; the sweep must survive and the winner
+        # must come from the finite half.
+        return float("nan") if args["x"] < 5.0 else args["x"]
+
+    trials = Trials()
+    best = fmin(objective, space, max_evals=20, trials=trials,
+                rstate=np.random.default_rng(0))
+    statuses = [t["result"]["status"] for t in trials.trials]
+    assert STATUS_FAIL in statuses and STATUS_OK in statuses
+    failed = [t for t in trials.trials
+              if t["result"]["status"] == STATUS_FAIL]
+    assert any("non-finite" in t["result"]["error"] for t in failed)
+    assert best["x"] >= 5.0
+    # The surrogate's history never sees a non-finite loss.
+    assert all(np.isfinite(loss) for _, loss in trials._history())
+
+
+def test_tpe_suggest_ignores_nonfinite_history_entries(rng):
+    space = {"x": hp.uniform("x", 0.0, 1.0)}
+    # Past startup, with poisoned entries interleaved: NaN would poison
+    # the good/bad argsort split without the filter.
+    history = [({"x": 0.1 * i}, float(i)) for i in range(8)]
+    history += [({"x": 0.5}, float("nan")), ({"x": 0.9}, float("inf"))]
+    out = TPE(n_startup_trials=5).suggest(space, history, rng)
+    assert 0.0 <= out["x"] <= 1.0
+
+    # All-poison history behaves like a fresh start (startup sampling).
+    poison = [({"x": 0.5}, float("nan"))] * 12
+    out = TPE(n_startup_trials=5).suggest(space, poison, rng)
+    assert 0.0 <= out["x"] <= 1.0
+
+
+def test_best_trial_skips_nonfinite_loss_recorded_by_foreign_store():
+    # A store that bypassed call_with_protocol (custom executor) may have
+    # recorded status=ok with a NaN loss; argmin must not crown it.
+    trials = Trials()
+    trials._record(0, {"x": 1.0}, {"loss": float("nan"), "status": STATUS_OK}, 0.0)
+    trials._record(1, {"x": 2.0}, {"loss": 3.0, "status": STATUS_OK}, 0.0)
+    assert trials.argmin() == {"x": 2.0}
+
+
+# -- CLI: dsst quarantine ----------------------------------------------------
+
+def test_cli_quarantine_list_and_clear(tmp_path, capsys):
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    q = QuarantineList(ckpt / "quarantine.jsonl")
+    q.add([RowRange("/data/p.parquet", 3, 16, 32)], reason="test", step=9)
+
+    # A checkpoint dir resolves to its quarantine.jsonl.
+    assert main(["quarantine", "list", str(ckpt)]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    entry = json.loads(out[0])
+    assert entry["row_group"] == 3 and entry["row_lo"] == 16
+
+    assert main(["quarantine", "clear", str(ckpt)]) == 0
+    assert "cleared 1" in capsys.readouterr().out
+    assert main(["quarantine", "list", str(ckpt)]) == 1  # nothing left

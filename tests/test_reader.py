@@ -166,3 +166,144 @@ def test_worker_exception_propagates_in_thread_pool(dataset):
             reader_pool_type="thread", workers_count=2,
         ) as reader:
             next(iter(reader))
+
+
+# -- provenance, quarantine, corrupt-sample isolation (PR 4) -----------------
+
+def _sorted_files(dataset):
+    return sorted(str(p) for p in dataset.glob("*.parquet"))
+
+
+def test_emit_provenance_tags_batches_with_exact_rows(dataset):
+    from dss_ml_at_scale_tpu.resilience.rollback import PROVENANCE_KEY
+
+    with batch_loader(
+        _sorted_files(dataset), batch_size=24, num_epochs=1,
+        shuffle_row_groups=False, reader_pool_type="dummy",
+        emit_provenance=True,
+    ) as reader:
+        batches = list(reader)
+    for b in batches:
+        prov = b[PROVENANCE_KEY]
+        assert sum(r.num_rows for r in prov) == len(b["id"])
+    # File order + dummy pool: batch 0 is rows [0,16) of rg0 + [0,8) of
+    # rg1 of the first file — provenance must say exactly that.
+    first = batches[0][PROVENANCE_KEY]
+    assert [(r.row_group, r.row_lo, r.row_hi) for r in first] == [
+        (0, 0, 16), (1, 0, 8),
+    ]
+
+
+def test_quarantined_rows_are_excluded_exactly(dataset, tmp_path):
+    """Reader-level exclusion repacks the surviving stream: the batches
+    equal a trainer-side skip of the same rows — the mechanism behind
+    deterministic rollback parity."""
+    from dss_ml_at_scale_tpu.resilience.rollback import (
+        PROVENANCE_KEY,
+        QuarantineList,
+    )
+
+    kwargs = dict(
+        batch_size=16, num_epochs=1, shuffle_row_groups=False,
+        reader_pool_type="dummy",
+    )
+    with batch_loader(
+        _sorted_files(dataset), emit_provenance=True, **kwargs
+    ) as reader:
+        batches = list(reader)
+    poison = batches[2]
+    q = QuarantineList(tmp_path / "q.jsonl")
+    q.add(poison[PROVENANCE_KEY], reason="chaos", step=3)
+
+    with batch_loader(
+        _sorted_files(dataset), quarantine=q, **kwargs
+    ) as reader:
+        excluded = [b["id"] for b in reader]
+    skipped = [b["id"] for i, b in enumerate(batches) if i != 2]
+    assert len(excluded) == len(skipped)
+    for a, b in zip(excluded, skipped):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_corrupt_sample_quarantined_and_skipped(dataset, tmp_path):
+    """on_corrupt="quarantine": a row whose transform raises is isolated,
+    counted, blocklisted, and dropped — the reader thread survives."""
+    from dss_ml_at_scale_tpu import telemetry
+    from dss_ml_at_scale_tpu.data.transform import Field
+    from dss_ml_at_scale_tpu.resilience.rollback import QuarantineList
+
+    def decode(cols):
+        if np.any(cols["id"] == 100):
+            raise ValueError("bad row")
+        return {"value": cols["value"].astype(np.float32)}
+
+    spec = TransformSpec(
+        func=decode, fields=[Field("value", np.dtype(np.float32), ())]
+    )
+
+    def counter_value():
+        for m in telemetry.snapshot()["metrics"]:
+            if m["name"] == "corrupt_samples_total":
+                return m["value"]
+        return 0.0
+
+    before = counter_value()
+    q = QuarantineList(tmp_path / "q.jsonl")
+    with batch_loader(
+        _sorted_files(dataset), batch_size=16, num_epochs=1,
+        shuffle_row_groups=False, transform_spec=spec, workers_count=2,
+        quarantine=q, on_corrupt="quarantine", drop_last=False,
+    ) as reader:
+        values = np.concatenate([b["value"] for b in reader])
+    assert len(values) == 255  # row id=100 dropped
+    assert 100.0 not in values
+    assert counter_value() - before == 1
+    assert len(q) == 1
+    entry = q.entries[0]
+    assert entry["row_hi"] - entry["row_lo"] == 1
+    assert "undecodable" in entry["reason"]
+
+    # Default on_corrupt="raise" preserves fail-fast semantics.
+    with pytest.raises(RuntimeError, match="worker failed"):
+        with batch_loader(
+            _sorted_files(dataset), batch_size=16, num_epochs=1,
+            transform_spec=spec, workers_count=2,
+        ) as reader:
+            list(reader)
+
+
+def test_sample_corrupt_fault_site_truncates_bytes(tmp_path):
+    """The sample.corrupt site: truncated payload bytes hit the real
+    decode error path and end up quarantined, deterministically."""
+    from dss_ml_at_scale_tpu.data.transform import Field
+    from dss_ml_at_scale_tpu.resilience import FaultPlan, faults
+    from dss_ml_at_scale_tpu.resilience.rollback import QuarantineList
+
+    t = pa.table({
+        "payload": pa.array([np.float64(i).tobytes() for i in range(32)],
+                            type=pa.binary()),
+    })
+    path = tmp_path / "bytes.parquet"
+    pq.write_table(t, path, row_group_size=16)
+
+    spec = TransformSpec(
+        func=lambda cols: {"value": np.array(
+            [np.frombuffer(b, np.float64, count=1)[0] for b in cols["payload"]],
+            np.float64,
+        )},
+        fields=[Field("value", np.dtype(np.float64), ())],
+    )
+    q = QuarantineList(tmp_path / "q.jsonl")
+    faults.install(FaultPlan.parse("sample.corrupt=1"))
+    try:
+        with batch_loader(
+            [str(path)], batch_size=16, num_epochs=1, drop_last=False,
+            shuffle_row_groups=False, reader_pool_type="dummy",
+            transform_spec=spec, quarantine=q, on_corrupt="quarantine",
+        ) as reader:
+            values = np.concatenate([b["value"] for b in reader])
+    finally:
+        faults.clear()
+    # Row 0 of the first row group was truncated mid-payload and dropped.
+    assert len(values) == 31 and 0.0 not in values
+    assert len(q) == 1 and q.entries[0]["row_lo"] == 0
