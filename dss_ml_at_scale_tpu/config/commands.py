@@ -426,6 +426,13 @@ def register_train(sub: argparse._SubParsersAction) -> None:
     tr.add_argument("--workers", type=int, default=2)
     tr.add_argument("--queue-size", type=int, default=20)
     tr.add_argument(
+        "--feeder-depth", type=int, default=2,
+        help="bound of the background feeder's on-device batch queue "
+        "(host-side shard + transfer overlaps step dispatch; HBM held "
+        "is depth extra batches). Occupancy/stall are exposed as "
+        "feeder_* series on /metrics and in dsst telemetry",
+    )
+    tr.add_argument(
         "--shard-opt-state", action="store_true",
         help="ZeRO-1: shard optimizer state over the data axis instead of "
         "replicating it (same math, ~world-size less optimizer memory)",
@@ -617,6 +624,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
             resume=args.resume,
             profile_dir=args.profile_dir,
             shard_opt_state=args.shard_opt_state,
+            feeder_depth=args.feeder_depth,
             health=health_cfg,
         ),
         mesh=make_mesh(),
@@ -949,6 +957,11 @@ def register_lm(sub: argparse._SubParsersAction) -> None:
     )
     lm.add_argument("--checkpoint-dir", default=None)
     lm.add_argument("--resume", action="store_true")
+    lm.add_argument(
+        "--feeder-depth", type=int, default=2,
+        help="bound of the background feeder's on-device batch queue "
+        "(see dsst train --feeder-depth)",
+    )
     _add_health_args(lm)
     _add_tracking_args(lm, "lm")
     lm.add_argument(
@@ -1035,6 +1048,7 @@ def _cmd_lm(args: argparse.Namespace) -> int:
             limit_val_batches=args.limit_val_batches,
             checkpoint_dir=args.checkpoint_dir,
             resume=args.resume,
+            feeder_depth=args.feeder_depth,
             health=health_cfg,
         ),
         mesh=mesh,
@@ -1603,12 +1617,15 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # diagnosis, same as predict/export); a KeyError from the much
     # larger Predictor construction below — e.g. an orbax tree that
     # doesn't match the model — must NOT be misattributed to
-    # dsst_model.json.
-    if _checkpoint_task(args.checkpoint_dir) is None:
+    # dsst_model.json. The resolved tuple is handed to Predictor so
+    # startup resolves the checkpoint exactly once.
+    resolved = _checkpoint_task(args.checkpoint_dir)
+    if resolved is None:
         return 1
     try:
         predictor = Predictor(args.checkpoint_dir, step=args.step,
-                              micro_batch=args.micro_batch)
+                              micro_batch=args.micro_batch,
+                              resolved=resolved)
     except FileNotFoundError as e:
         # Missing orbax steps: print the diagnosis and exit like
         # predict/export, no traceback.

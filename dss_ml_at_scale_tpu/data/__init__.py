@@ -5,7 +5,8 @@ Petastorm ``make_batch_reader``/``DataLoader``/``TransformSpec``
 (reference ``deep_learning/2.distributed-data-loading-petastorm.py:246-318``)
 and the deltalake-rs file listing (``:99-112``) — built on pyarrow's C++
 Parquet engine with a host-side decode worker pool, a bounded results
-queue, and double-buffered transfer to device.
+queue, and a background feeder thread that stages + shards batches to
+device so transfer overlaps the step loop (see ``prefetch.py``).
 """
 
 from .delta import DeltaTable, write_delta  # noqa: F401
@@ -20,8 +21,11 @@ def __getattr__(name):
     # prefetch imports jax, which initializes the accelerator backend on
     # import; loaded lazily so jax-free paths (datagen subprocesses, pure
     # Delta IO) never touch the device runtime.
-    if name == "prefetch_to_mesh":
-        from .prefetch import prefetch_to_mesh
+    if name in (
+        "prefetch_to_mesh", "prefetch_to_devices",
+        "Feeder", "MeshFeeder", "DeviceFeeder",
+    ):
+        from . import prefetch
 
-        return prefetch_to_mesh
+        return getattr(prefetch, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

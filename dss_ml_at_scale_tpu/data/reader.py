@@ -152,6 +152,38 @@ class ParquetShardReader:
 
     # -- diagnostics ------------------------------------------------------
 
+    @property
+    def queue_occupancy(self) -> int:
+        """Decoded row groups currently waiting in the results queue."""
+        results = self._results
+        return results.qsize() if results is not None else 0
+
+    def _telemetry_handles(self):
+        """Decode-pipeline gauge/counter children, bound ONCE per reader.
+
+        The import stays lazy (telemetry pulls jax via its device
+        module; jax-free paths — datagen subprocesses, pure Delta IO —
+        must not touch the device runtime), but re-iterating the reader
+        no longer pays a registry lookup per epoch, and the consumer
+        loop's per-row-group cost is two pre-bound method calls.
+        """
+        handles = getattr(self, "_telemetry", None)
+        if handles is None:
+            from .. import telemetry
+
+            handles = self._telemetry = (
+                telemetry.gauge(
+                    "reader_queue_depth",
+                    "decoded row groups waiting in the results queue at "
+                    "last consumer read",
+                ),
+                telemetry.counter(
+                    "reader_stall_seconds_total",
+                    "cumulative consumer wait on the decode queue",
+                ),
+            )
+        return handles
+
     def memory_estimate(self, row_size_bytes: int) -> int:
         """Worst-case host RAM of the decode pipeline, in bytes.
 
@@ -353,16 +385,7 @@ class ParquetShardReader:
         # Decode-pipeline health gauges: queue depth says whether workers
         # keep ahead of the consumer; stall time is the consumer-side
         # cost when they don't (the "is training input-bound?" number).
-        from .. import telemetry
-
-        queue_gauge = telemetry.gauge(
-            "reader_queue_depth", "decoded row groups waiting in the "
-            "results queue at last consumer read"
-        )
-        stall_total = telemetry.counter(
-            "reader_stall_seconds_total",
-            "cumulative consumer wait on the decode queue",
-        )
+        queue_gauge, stall_total = self._telemetry_handles()
         self._threads = [
             threading.Thread(
                 target=self._worker, args=(work, lock, results), daemon=True,

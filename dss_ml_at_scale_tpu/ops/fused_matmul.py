@@ -183,14 +183,18 @@ def _bwd_dw_kernel(*refs, with_res):
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _make_op(with_res: bool, interpret: bool, eps: float, n_count: int,
+def _make_op(with_res: bool, interpret: bool, eps: float,
              axis_name: str | None = None, batch_stats: bool = True):
     """Op for one configuration; shapes already padded: y [M, K],
     gamma/beta/mean/var [1, K] f32, w [K, N]; M % _TM == 0,
-    K % _LANE == 0, N % _LANE == 0.  ``n_count`` is the UNPADDED row
-    count — the N of the batch statistics' mean, which the backward's
-    stats correction divides by (padded rows carry zero cotangents, so
-    the sums are unaffected, but the divisor must be the real one).
+    K % _LANE == 0, N % _LANE == 0.  The op takes an extra trailing
+    ``n_count`` operand (f32 scalar, TRACED): the UNPADDED row count —
+    the N of the batch statistics' mean, which the backward's stats
+    correction divides by (padded rows carry zero cotangents, so the
+    sums are unaffected, but the divisor must be the real one). Traced
+    rather than baked into this cache key so variable-shape callers
+    can't leak one custom_vjp op per distinct M — the key space here is
+    a handful of static configurations, a naturally bounded cache.
 
     With ``axis_name`` (shard_map over the flattened-M axis): the
     channel sums feeding ``dy``'s statistics correction are ``psum``-ed
@@ -228,25 +232,27 @@ def _make_op(with_res: bool, interpret: bool, eps: float, n_count: int,
             interpret=interpret,
         )(*ys, s, t, w)
 
-    def f(y, gamma, beta, mean, var, w, *maybe_res):
+    def f(y, gamma, beta, mean, var, w, n_count, *maybe_res):
         s, t, _ = _vectors(gamma, beta, mean, var)
         res = maybe_res[0] if with_res else None
         return _call_fwd(y, s, t, w, res)
 
-    def f_fwd(y, gamma, beta, mean, var, w, *maybe_res):
+    def f_fwd(y, gamma, beta, mean, var, w, n_count, *maybe_res):
         s, t, inv = _vectors(gamma, beta, mean, var)
         res = maybe_res[0] if with_res else None
         out = _call_fwd(y, s, t, w, res)
         # Saved: y (the raw conv output — the only activation-sized
         # tensor, and the one the surrounding graph keeps alive
-        # anyway), the per-channel vectors, and w.  The normalized
-        # activation is never materialized.
-        saved = (y, s, t, mean, inv, w) + ((res,) if with_res else ())
+        # anyway), the per-channel vectors, w, and the scalar row
+        # count.  The normalized activation is never materialized.
+        saved = (y, s, t, mean, inv, w, n_count) + (
+            (res,) if with_res else ()
+        )
         return out, saved
 
     def f_bwd(saved, g):
-        y, s, t, mean, inv, w = saved[:6]
-        res = saved[6] if with_res else None
+        y, s, t, mean, inv, w, n_count = saved[:7]
+        res = saved[7] if with_res else None
         m, k = y.shape
         n = w.shape[1]
         ys = [y] + ([res] if with_res else [])
@@ -310,14 +316,16 @@ def _make_op(with_res: bool, interpret: bool, eps: float, n_count: int,
         gt32 = gt.astype(jnp.float32)
         if batch_stats:
             x_hat = (y.astype(jnp.float32) - mean) * inv
-            dy32 = s * (gt32 - (g_sum + x_hat * gx_sum) / float(n_count))
+            # n_count is a traced f32 scalar operand (not part of the
+            # op-cache key), so variable-M callers reuse one op.
+            dy32 = s * (gt32 - (g_sum + x_hat * gx_sum) / n_count)
         else:
             dy32 = s * gt32
         dy = dy32.astype(y.dtype)
         dgamma = sum_gx
         dbeta = sum_g
         grads = (dy, dgamma, dbeta, jnp.zeros_like(mean),
-                 jnp.zeros_like(mean), dw)
+                 jnp.zeros_like(mean), dw, jnp.zeros_like(n_count))
         if with_res:
             grads = grads + (gt,)
         return grads
@@ -408,9 +416,11 @@ def bn_relu_matmul(
         return _pad_to(v.astype(jnp.float32).reshape(1, k), 1, _LANE)
 
     op = _make_op(res2 is not None, bool(interpret), float(eps),
-                  global_count if global_count is not None else m,
                   axis_name, bool(batch_stats))
-    args = (y2, row(gamma), row(beta), row(mean), row(var), w2)
+    n_count = jnp.asarray(
+        global_count if global_count is not None else m, jnp.float32
+    )
+    args = (y2, row(gamma), row(beta), row(mean), row(var), w2, n_count)
     if res2 is not None:
         args = args + (res2,)
     out = op(*args)

@@ -22,7 +22,6 @@ Semantics carried over from the reference driver:
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 import itertools
 import logging
@@ -40,12 +39,11 @@ from flax.core import FrozenDict
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry
-from ..data.prefetch import prefetch_to_mesh
+from ..data.prefetch import MeshFeeder, split_provenance
 from ..resilience import checkpoint as integrity
 from ..resilience import health
 from ..resilience.faults import maybe_fail
 from ..resilience.preemption import PreemptionGuard
-from ..resilience.rollback import PROVENANCE_KEY
 from ..models.metrics import (
     cross_entropy_loss,
     multiclass_accuracy,
@@ -58,6 +56,12 @@ from ..utils.profiling import StepTimer
 log = logging.getLogger(__name__)
 
 Batch = Mapping[str, Any]
+
+# 1-in-N sampling for the per-step histograms (step time, data wait):
+# distribution estimates don't need every step, and the exact totals
+# ride counters (feeder_stall_seconds_total) / per-epoch StepTimer
+# summaries instead.
+_HIST_SAMPLE_EVERY = 4
 
 
 class TrainState(struct.PyTreeNode):
@@ -319,6 +323,11 @@ class TrainerConfig:
     best_metric: str | None = None
     best_mode: str | None = None
     resume: bool = False
+    # Bound of the background feeder's on-device batch queue (HBM held:
+    # feeder_depth batches beyond the in-flight step). ``prefetch_depth``
+    # is the legacy name for the same knob; ``feeder_depth`` wins when
+    # both are set.
+    feeder_depth: int | None = None
     prefetch_depth: int = 2
     # jax.profiler trace capture (SURVEY.md §5.1): when profile_dir is
     # set, a trace covering steps [profile_start_step,
@@ -378,6 +387,14 @@ class Trainer:
 
     # -- accounting -------------------------------------------------------
 
+    @staticmethod
+    def _feeder_depth(cfg: TrainerConfig) -> int:
+        return (
+            cfg.feeder_depth
+            if cfg.feeder_depth is not None
+            else cfg.prefetch_depth
+        )
+
     def _steps_per_epoch(self, per_process_batch: int) -> int:
         cfg = self.config
         if cfg.steps_per_epoch is not None:
@@ -431,7 +448,10 @@ class Trainer:
         rng = rng if rng is not None else jax.random.key(0)
 
         train_iter = iter(train_data)
-        first, first_prov = _split_provenance(next(train_iter))
+        raw_first = next(train_iter)
+        # Provenance is stripped by the feeder; this peek only sizes and
+        # initializes, so the side channel is popped locally too.
+        first, _ = split_provenance(raw_first)
         # Examples per batch: the leading dim by default; tasks whose
         # batches aren't [batch, ...] (PipelinedTask: [n_micro, mb, ...])
         # declare a ``batch_size_of`` hook so steps/epoch and throughput
@@ -515,36 +535,17 @@ class Trainer:
             # uninterrupted run exactly.
             start_epoch = int(state.step) // steps_per_epoch
 
-        # Batch provenance (reader-tagged RowRanges under _provenance) is
-        # host-side metadata: stripped before device transfer, queued in
-        # arrival order so the supervised loop can quarantine the exact
-        # rows behind a discarded step. prefetch_to_mesh preserves source
-        # order, so FIFO position n is device batch n.
-        prov_fifo: collections.deque = collections.deque()
-
-        def batches():
-            if supervisor is not None:
-                prov_fifo.append(first_prov)
-            yield first
-            for raw in train_iter:
-                b, prov = _split_provenance(raw)
-                if supervisor is not None:
-                    prov_fifo.append(prov)
-                yield b
-
-        device_batches = prefetch_to_mesh(
-            batches(), mesh, depth=cfg.prefetch_depth, specs=cfg.batch_specs
-        )
-
         history: list[dict] = []
         best_value, best_step = self._prior_best(manager, cfg)
         sign = 1.0 if cfg.best_mode == "max" else -1.0
         step = int(state.step)  # host-side mirror, synced once before the loop
         data_exhausted = False
         # Telemetry series (process registry): step time, data wait,
-        # throughput, compile events. Handles hoisted out of the loop; the
-        # per-step cost is two clock reads + histogram observes + a cache
-        # probe — no device sync added to the hot path.
+        # throughput, compile events. Handles hoisted out of the loop
+        # and the two step-rate histograms SAMPLED (1-in-N observes;
+        # exact totals ride the feeder's counters) — the per-step cost
+        # is one queue.get, one clock read, and a cache probe; no device
+        # sync on the hot path.
         step_hist = telemetry.histogram(
             "train_step_seconds", "wall time between dispatched train steps"
         )
@@ -563,10 +564,32 @@ class Trainer:
                 "train_step executable compiles (first step + retraces)",
             ),
         )
-        step_timer = StepTimer(observer=step_hist.observe)
+        step_timer = StepTimer(
+            observer=telemetry.SampledObserver(
+                step_hist, _HIST_SAMPLE_EVERY
+            ).observe
+        )
         tracing = False
         preempted = False
         guard = PreemptionGuard()
+
+        # The background feeder: pulls reader batches, strips row
+        # provenance (it rides the queue WITH its device batch, so the
+        # supervised loop's row accounting keeps exact parity), stages +
+        # shards them through the cached placer, and overlaps all of it
+        # with step dispatch. Closed in the ``finally`` on EVERY exit —
+        # exhaustion, health abort, preemption — so no feeder thread
+        # outlives fit.
+        feeder = MeshFeeder(
+            itertools.chain([raw_first], train_iter),
+            mesh,
+            depth=self._feeder_depth(cfg),
+            specs=cfg.batch_specs,
+            name="train",
+            wait_observer=telemetry.SampledObserver(
+                wait_hist, _HIST_SAMPLE_EVERY
+            ).observe,
+        )
 
         try:
             with guard:
@@ -591,16 +614,15 @@ class Trainer:
                     # falls out of the same arithmetic.
                     epoch_end_step = (epoch + 1) * steps_per_epoch
                     while step < epoch_end_step:
-                        wait_t0 = time.perf_counter()
+                        # One queue.get: the feeder already staged,
+                        # sharded, and enqueued the batch (and accounted
+                        # the wait into train_data_wait_seconds /
+                        # feeder_stall_seconds_total).
                         try:
-                            batch = next(device_batches)
+                            batch, prov = next(feeder)
                         except StopIteration:
                             data_exhausted = True
                             break
-                        wait_hist.observe(time.perf_counter() - wait_t0)
-                        prov = (
-                            prov_fifo.popleft() if supervisor is not None else None
-                        )
                         if cfg.profile_dir is not None and not tracing and (
                             step >= cfg.profile_start_step
                         ):
@@ -762,10 +784,14 @@ class Trainer:
         finally:
             # Teardown runs on EVERY exit, including a health abort
             # (TrainingHealthError is an expected, caught-by-the-CLI
-            # exception): a live profiler trace must be closed and the
-            # in-flight async save + manifest finalizer joined, or the
-            # process continues with a truncated trace and a checkpoint
-            # whose manifest never lands.
+            # exception): the feeder thread must be stopped and joined
+            # (a daemon thread must not outlive fit, and a producer
+            # blocked on a full queue must be unblocked), a live
+            # profiler trace must be closed, and the in-flight async
+            # save + manifest finalizer joined, or the process continues
+            # with a truncated trace and a checkpoint whose manifest
+            # never lands.
+            feeder.close()
             if tracing:
                 jax.block_until_ready(state.params)
                 jax.profiler.stop_trace()
@@ -802,24 +828,28 @@ class Trainer:
         totals: dict[str, float] = {}
         count = 0
         val_data = val_data_factory()
+        feeder = None
         try:
-            # Limit BEFORE prefetch so no extra batches are decoded and
+            # Limit BEFORE the feeder so no extra batches are decoded and
             # shipped to HBM just to be discarded.
             source = iter(val_data)
             if cfg.limit_val_batches is not None:
                 source = itertools.islice(source, cfg.limit_val_batches)
-            val_batches = prefetch_to_mesh(
-                source, self.mesh, depth=cfg.prefetch_depth,
-                specs=cfg.batch_specs,
+            feeder = MeshFeeder(
+                source, self.mesh, depth=self._feeder_depth(cfg),
+                specs=cfg.batch_specs, name="eval",
             )
-            for batch in val_batches:
+            for batch, _prov in feeder:
                 m = eval_step(state, batch)
                 for k, v in m.items():
                     totals[k] = totals.get(k, 0.0) + float(v)
                 count += 1
         finally:
-            # Stop streaming readers eagerly — limit_val_batches may leave
-            # the source mid-stream with worker threads still decoding.
+            # Join the feeder thread, then stop streaming readers
+            # eagerly — limit_val_batches may leave the source
+            # mid-stream with worker threads still decoding.
+            if feeder is not None:
+                feeder.close()
             stop = getattr(val_data, "stop", None)
             if callable(stop):
                 stop()
@@ -1187,20 +1217,6 @@ def restore_state(
         manager, _to_pytree(state), steps=order
     )
     return TrainState(**restored), used
-
-
-def _split_provenance(batch: Batch) -> tuple[Batch, Any]:
-    """Pop the reader's row-provenance side channel off a batch.
-
-    Provenance is host metadata (a list of RowRanges) — it must never
-    reach ``device_put``. Returned separately so the supervised loop can
-    quarantine the exact rows behind a discarded step; None for batches
-    without it (in-memory iterables, provenance-disabled readers).
-    """
-    if PROVENANCE_KEY in batch:
-        prov = batch[PROVENANCE_KEY]
-        return {k: v for k, v in batch.items() if k != PROVENANCE_KEY}, prov
-    return batch, None
 
 
 def _ocp():

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 from typing import Mapping, Sequence
 
 import jax
@@ -94,21 +95,43 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch_to_mesh(batch, mesh: Mesh, axis: str = "data", specs=None):
-    """Place a host-global pytree of arrays onto the mesh, batch-sharded.
+class MeshBatchPlacer:
+    """Cached, batched host→mesh placement for a fixed (mesh, axis, specs).
 
-    In a multi-process run each process passes its *local* shard and JAX
-    assembles the global array (``jax.make_array_from_process_local_data``);
-    single-process, this is a plain sharded device_put. Scalar (0-d)
-    leaves have no batch dim and are replicated.
+    ``shard_batch_to_mesh`` used to rebuild ``NamedSharding`` objects and
+    re-validate divisibility for every leaf of every batch — host work
+    that serializes with step dispatch at feeder rates. The placer does
+    that once per distinct batch STRUCTURE (treedef + leaf shapes),
+    caches the per-leaf shardings, and places subsequent batches of the
+    same structure with ONE batched ``jax.device_put`` call over the
+    whole flattened pytree (a single transfer dispatch instead of one
+    per leaf). Validation errors are identical to the uncached path —
+    nothing is cached when plan construction raises.
 
-    ``specs`` (optional, Mapping key → ``PartitionSpec``) overrides the
-    default leading-dim sharding for named top-level keys — e.g.
-    ``{"tokens": P(None, "sp")}`` shards the sequence dimension for
-    sequence-parallel training. ``batch`` must be a Mapping when
-    ``specs`` is given.
+    Thread-safe: the feeder thread is the intended caller, but the same
+    instance may also be driven from the training thread (eval).
     """
-    def _local_slice(shard_factor: int) -> int:
+
+    # Structure-plan bound: training sees one or two shapes (steady
+    # batch + a drop_last=False tail); anything past this is a shape
+    # leak, and evicting oldest keeps the cache harmless anyway.
+    _MAX_PLANS = 128
+
+    def __init__(self, mesh: Mesh, axis: str = "data", specs=None):
+        self.mesh = mesh
+        self.axis = axis
+        self.specs = dict(specs) if specs else None
+        self._lock = threading.Lock()
+        self._shardings: dict = {}  # PartitionSpec -> NamedSharding
+        self._plans: dict = {}      # (treedef, shapes) -> [NamedSharding]
+
+    def _sharding(self, spec) -> NamedSharding:
+        s = self._shardings.get(spec)
+        if s is None:
+            s = self._shardings[spec] = NamedSharding(self.mesh, spec)
+        return s
+
+    def _local_slice(self, shard_factor: int) -> int:
         # Each process contributes its local rows, so the divisibility
         # that matters is against the local slice of the shard factor
         # (the global factor in single-process runs).
@@ -116,67 +139,137 @@ def shard_batch_to_mesh(batch, mesh: Mesh, axis: str = "data", specs=None):
             return shard_factor // jax.process_count()
         return shard_factor
 
-    def _place_spec(x, spec):
+    def _spec_sharding(self, x, spec) -> NamedSharding:
         # Validate up front — an axis name missing from the mesh or an
         # indivisible sharded dim otherwise surfaces as an opaque XLA /
         # NamedSharding error instead of the ValueError the default
-        # ``_place`` path raises.
+        # path raises.
         for dim, entry in enumerate(spec):
             if entry is None:
                 continue
             names = entry if isinstance(entry, tuple) else (entry,)
             shard_factor = 1
             for name in names:
-                if name not in mesh.shape:
+                if name not in self.mesh.shape:
                     raise ValueError(
                         f"spec axis {name!r} not in mesh axes "
-                        f"{sorted(mesh.shape)}"
+                        f"{sorted(self.mesh.shape)}"
                     )
-                shard_factor *= mesh.shape[name]
-            shard_factor = _local_slice(shard_factor)
+                shard_factor *= self.mesh.shape[name]
+            shard_factor = self._local_slice(shard_factor)
             if dim >= np.ndim(x) or np.shape(x)[dim] % shard_factor:
                 dim_size = np.shape(x)[dim] if dim < np.ndim(x) else "absent"
                 raise ValueError(
                     f"dim {dim} (size {dim_size}) not divisible by the "
                     f"local slice ({shard_factor}) of mesh axes {names}"
                 )
-        sharding = NamedSharding(mesh, spec)
-        if jax.process_count() > 1:
-            # Same contract as the default path: each process passes its
-            # LOCAL shard and JAX assembles the global array.
-            return jax.make_array_from_process_local_data(
-                sharding, np.asarray(x)
-            )
-        return jax.device_put(x, sharding)
+        return self._sharding(spec)
 
-    def _place(x):
+    def _default_sharding(self, x) -> NamedSharding:
         if np.ndim(x) == 0:
-            return jax.device_put(x, NamedSharding(mesh, P()))
-        local_axis = _local_slice(mesh.shape[axis])
+            return self._sharding(P())
+        local_axis = self._local_slice(self.mesh.shape[self.axis])
         if np.shape(x)[0] % local_axis:
             raise ValueError(
-                f"leading (batch) dim {np.shape(x)[0]} not divisible by the "
-                f"local slice ({local_axis}) of mesh axis '{axis}'"
+                f"leading (batch) dim {np.shape(x)[0]} not divisible by "
+                f"the local slice ({local_axis}) of mesh axis "
+                f"'{self.axis}'"
             )
-        sharding = NamedSharding(mesh, P(axis, *([None] * (np.ndim(x) - 1))))
+        return self._sharding(P(self.axis, *([None] * (np.ndim(x) - 1))))
+
+    def _leaf_sharding(self, path, x) -> NamedSharding:
+        if self.specs is not None and path and (
+            getattr(path[0], "key", None) in self.specs
+        ):
+            if len(path) > 1:
+                raise TypeError(
+                    f"specs key {path[0].key!r} targets a nested pytree; "
+                    "per-key PartitionSpecs apply to array values only"
+                )
+            return self._spec_sharding(x, self.specs[path[0].key])
+        return self._default_sharding(x)
+
+    def __call__(self, batch):
+        if self.specs is not None:
+            if not isinstance(batch, Mapping):
+                raise TypeError(
+                    "shard_batch_to_mesh(specs=...) needs a Mapping batch"
+                )
+            unknown = set(self.specs) - set(batch)
+            if unknown:
+                # A misspelled key silently falling back to batch
+                # sharding would produce wrong layouts (and wrong math)
+                # with no error.
+                raise KeyError(
+                    f"specs keys not in batch: {sorted(unknown)}; "
+                    f"batch has {sorted(batch)}"
+                )
+        flat, treedef = jax.tree_util.tree_flatten_with_path(batch)
+        key = (treedef, tuple(np.shape(x) for _, x in flat))
+        with self._lock:
+            shardings = self._plans.get(key)
+        if shardings is None:
+            shardings = [self._leaf_sharding(p, x) for p, x in flat]
+            with self._lock:
+                if len(self._plans) >= self._MAX_PLANS:
+                    self._plans.pop(next(iter(self._plans)))
+                self._plans[key] = shardings
         if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sharding, np.asarray(x))
-        return jax.device_put(x, sharding)
+            # Each process passes its LOCAL shard and JAX assembles the
+            # global array; scalars (no batch dim) replicate directly.
+            placed = [
+                jax.device_put(x, s) if np.ndim(x) == 0
+                else jax.make_array_from_process_local_data(s, np.asarray(x))
+                for (_, x), s in zip(flat, shardings)
+            ]
+        else:
+            placed = jax.device_put([x for _, x in flat], shardings)
+        return jax.tree_util.tree_unflatten(treedef, placed)
 
-    if specs:
-        if not isinstance(batch, Mapping):
-            raise TypeError("shard_batch_to_mesh(specs=...) needs a Mapping batch")
-        unknown = set(specs) - set(batch)
-        if unknown:
-            # A misspelled key silently falling back to batch sharding
-            # would produce wrong layouts (and wrong math) with no error.
-            raise KeyError(
-                f"specs keys not in batch: {sorted(unknown)}; "
-                f"batch has {sorted(batch)}"
+
+# Placers keyed by (mesh, axis, specs) so repeat shard_batch_to_mesh
+# calls share one plan cache. Bounded: a process holds a handful of
+# meshes at most, and stale entries are only cached shardings.
+_PLACERS: dict = {}
+_PLACERS_LOCK = threading.Lock()
+_MAX_PLACERS = 32
+
+
+def get_batch_placer(
+    mesh: Mesh, axis: str = "data", specs=None
+) -> MeshBatchPlacer:
+    """Shared :class:`MeshBatchPlacer` for this (mesh, axis, specs)."""
+    key = (
+        mesh, axis,
+        tuple(sorted(specs.items())) if specs else None,
+    )
+    with _PLACERS_LOCK:
+        placer = _PLACERS.get(key)
+        if placer is None:
+            if len(_PLACERS) >= _MAX_PLACERS:
+                _PLACERS.pop(next(iter(_PLACERS)))
+            placer = _PLACERS[key] = MeshBatchPlacer(
+                mesh, axis=axis, specs=specs
             )
-        return {
-            k: (_place_spec(v, specs[k]) if k in specs else _place(v))
-            for k, v in batch.items()
-        }
+    return placer
 
-    return jax.tree_util.tree_map(_place, batch)
+
+def shard_batch_to_mesh(batch, mesh: Mesh, axis: str = "data", specs=None):
+    """Place a host-global pytree of arrays onto the mesh, batch-sharded.
+
+    In a multi-process run each process passes its *local* shard and JAX
+    assembles the global array (``jax.make_array_from_process_local_data``);
+    single-process, this is one batched sharded device_put. Scalar (0-d)
+    leaves have no batch dim and are replicated.
+
+    ``specs`` (optional, Mapping key → ``PartitionSpec``) overrides the
+    default leading-dim sharding for named top-level keys — e.g.
+    ``{"tokens": P(None, "sp")}`` shards the sequence dimension for
+    sequence-parallel training. ``batch`` must be a Mapping when
+    ``specs`` is given.
+
+    Sharding objects and per-structure placement plans are cached (see
+    :class:`MeshBatchPlacer`); hot-path callers that own their stream
+    (the feeder) should hold a placer via :func:`get_batch_placer`.
+    """
+    return get_batch_placer(mesh, axis=axis, specs=specs)(batch)

@@ -24,6 +24,7 @@ from .registry import (
     DEFAULT_BUCKETS,
     MetricFamily,
     MetricsRegistry,
+    SampledObserver,
     log_buckets,
 )
 from .spans import SpanLog, export_perfetto, to_perfetto
@@ -34,6 +35,7 @@ __all__ = [
     "DeviceMonitor",
     "MetricFamily",
     "MetricsRegistry",
+    "SampledObserver",
     "SpanLog",
     "collect_remote_snapshots",
     "counter",

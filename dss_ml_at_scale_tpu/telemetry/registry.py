@@ -150,6 +150,35 @@ _CHILD_TYPES = {
 }
 
 
+class SampledObserver:
+    """Record every Nth observation into a histogram family/child.
+
+    The per-step instrumentation budget is paid once per *training step*;
+    a full histogram observe (lock + bisect) on every step is cheap but
+    not free, and the distribution estimate doesn't need every sample.
+    This wrapper forwards 1-in-``every`` values — bucket shapes and
+    means survive sampling; exact totals should ride a counter instead
+    (the feeder keeps ``*_seconds_total`` counters exact for this
+    reason). The skip counter is unlocked: a rare race drops or doubles
+    one sample, which is noise at the rates this is built for.
+    """
+
+    __slots__ = ("_observe", "_every", "_n")
+
+    def __init__(self, family, every: int = 8):
+        if every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self._observe = family.observe
+        self._every = int(every)
+        self._n = 0
+
+    def observe(self, v: float) -> None:
+        self._n += 1
+        if self._n >= self._every:
+            self._n = 0
+            self._observe(v)
+
+
 class MetricFamily:
     """A named metric plus its per-label-set children.
 

@@ -82,7 +82,12 @@ class Predictor:
     """
 
     def __init__(self, checkpoint_dir: str, *, step: int | None = None,
-                 micro_batch: int = 8):
+                 micro_batch: int = 8, resolved=None):
+        """``resolved``: an already-computed ``resolve_checkpoint``
+        result tuple ``(meta, crop, model, task)`` — callers that
+        resolved the checkpoint for their own diagnostics (``dsst
+        serve``) pass it through instead of paying the metadata read,
+        model build, and validation a second time at startup."""
         import numpy as np
 
         import jax.numpy as jnp
@@ -90,8 +95,9 @@ class Predictor:
         from ..config.checkpoints import make_scorer, resolve_checkpoint
         from ..parallel import restore_state
 
-        self.meta, self.crop, model, task = resolve_checkpoint(
-            checkpoint_dir
+        self.meta, self.crop, model, task = (
+            resolved if resolved is not None
+            else resolve_checkpoint(checkpoint_dir)
         )
         self.micro_batch = int(micro_batch)
         self.label_names = self.meta.get("label_names")

@@ -33,6 +33,11 @@ from scipy import linalg, optimize
 KAPPA = 1e4
 LOG2PI = float(np.log(2 * np.pi))
 
+# Fit-bar settings, named so the fixture's input hash can cover them:
+# changing either invalidates every stored bar.
+FIT_RESTARTS = 3
+NM_OPTIONS = {"maxiter": 4000, "xatol": 1e-6, "fatol": 1e-8}
+
 
 # ---------------------------------------------------------------------------
 # Oracle: plain-NumPy SARIMAX (unpadded, loop-based — independent of ops/)
@@ -140,7 +145,7 @@ def oracle_filter(y, exog, beta, phi, theta, sigma2, d, n_valid):
     return ll, xb + r_pred
 
 
-def oracle_fit(y, exog, order, n_valid, restarts: int = 3):
+def oracle_fit(y, exog, order, n_valid, restarts: int = FIT_RESTARTS):
     """Best loglike from scipy Nelder-Mead on the UNPADDED params."""
     p, d, q = order
     y = np.asarray(y, float)
@@ -167,13 +172,11 @@ def oracle_fit(y, exog, order, n_valid, restarts: int = 3):
     starts = [x0] + [x0 + rng.normal(0, 0.1, len(x0)) for _ in range(restarts - 1)]
     for s in starts:
         res = optimize.minimize(
-            nll, s, method="Nelder-Mead",
-            options={"maxiter": 4000, "xatol": 1e-6, "fatol": 1e-8},
+            nll, s, method="Nelder-Mead", options=dict(NM_OPTIONS),
         )
         # Polish with a restarted simplex around the incumbent.
         res = optimize.minimize(
-            nll, res.x, method="Nelder-Mead",
-            options={"maxiter": 4000, "xatol": 1e-6, "fatol": 1e-8},
+            nll, res.x, method="Nelder-Mead", options=dict(NM_OPTIONS),
         )
         if best is None or res.fun < best.fun:
             best = res
@@ -268,6 +271,32 @@ def _pinned_case(y, exog, order, phi_pool, theta_pool, n_valid,
     }
 
 
+def _fit_inputs_hash(y, exog, n_valid, ny, nexog, n_nvalid) -> str:
+    """SHA-256 over everything a stored fit bar depends on: both series
+    (values, exog, validity windows) and the fit settings (restarts,
+    simplex options, kappa). ``--merge-existing`` compares this against
+    the fixture's stored hash so stale bars — computed from a different
+    series or looser optimizer settings — can never be silently merged
+    into a regenerated grid."""
+    import hashlib
+
+    payload = json.dumps(
+        {
+            "y": np.asarray(y).tolist(),
+            "exog": np.asarray(exog).tolist(),
+            "n_valid": int(n_valid),
+            "nur_y": np.asarray(ny).tolist(),
+            "nur_exog": np.asarray(nexog).tolist(),
+            "nur_n_valid": int(n_nvalid),
+            "kappa": KAPPA,
+            "restarts": FIT_RESTARTS,
+            "nm_options": NM_OPTIONS,
+        },
+        sort_keys=True,
+    ).encode()
+    return hashlib.sha256(payload).hexdigest()
+
+
 def main() -> None:
     import argparse
 
@@ -275,21 +304,34 @@ def main() -> None:
     ap.add_argument(
         "--merge-existing", action="store_true",
         help="reuse fit bars already present in sarimax_golden.json "
-        "(same series/params by construction); compute only missing "
-        "orders — lets the 75-order grid build incrementally",
+        "(verified against the stored fit-inputs hash — refuses if the "
+        "series or fit settings changed); compute only missing orders — "
+        "lets the 75-order grid build incrementally",
     )
     args = ap.parse_args()
     path = Path(__file__).with_name("sarimax_golden.json")
+
+    y, exog, n_valid = make_series()
+    ny, nexog, n_nvalid = make_nur_series()
+    inputs_hash = _fit_inputs_hash(y, exog, n_valid, ny, nexog, n_nvalid)
+
     prior_fits: dict[tuple, float] = {}
     prior_nur: dict | None = None
     if args.merge_existing and path.exists():
         prior = json.loads(path.read_text())
+        prior_hash = prior.get("fit_inputs_sha256")
+        if prior_hash != inputs_hash:
+            raise SystemExit(
+                f"--merge-existing refused: {path.name} was generated "
+                f"from different series/fit settings (stored hash "
+                f"{prior_hash or 'absent'}, current {inputs_hash}). "
+                "Regenerate from scratch (drop --merge-existing) so "
+                "stale loglike bars can't be silently merged."
+            )
         prior_fits = {
             tuple(f["order"]): f["loglike"] for f in prior.get("fits", [])
         }
         prior_nur = prior.get("nur")
-
-    y, exog, n_valid = make_series()
     cases = [
         _pinned_case(y, exog, order, PHI_POOL, THETA_POOL, n_valid)
         for order in GRID_ORDERS
@@ -334,7 +376,6 @@ def main() -> None:
         nur_block = prior_nur
         print("nur block reused")
     else:
-        ny, nexog, n_nvalid = make_nur_series()
         nur_cases = [
             _pinned_case(ny, nexog, order, NUR_PHI, THETA_POOL, n_nvalid,
                          beta=[3.0, 5.0])
@@ -362,6 +403,7 @@ def main() -> None:
 
     out = {
         "kappa": KAPPA,
+        "fit_inputs_sha256": inputs_hash,
         "n_valid": int(n_valid),
         "y": y.tolist(),
         "exog": exog.tolist(),

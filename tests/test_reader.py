@@ -51,6 +51,24 @@ def test_list_and_shard_units(dataset):
     ]
 
 
+def test_queue_occupancy_tracks_results_queue(dataset):
+    reader = ParquetShardReader(
+        sorted(str(p) for p in dataset.glob("*.parquet")),
+        batch_size=16, num_epochs=1, results_queue_size=4,
+    )
+    assert reader.queue_occupancy == 0  # not iterating yet
+    it = iter(reader)
+    next(it)
+    # Workers run ahead of a stalled consumer up to the queue bound.
+    import time
+
+    deadline = time.monotonic() + 2.0
+    while reader.queue_occupancy < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert 0 < reader.queue_occupancy <= 4
+    reader.stop()
+
+
 def test_single_epoch_reads_all_rows(dataset):
     with batch_loader(
         dataset, batch_size=32, num_epochs=1, workers_count=3, shuffle_row_groups=False
