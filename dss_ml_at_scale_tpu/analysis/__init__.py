@@ -1,0 +1,43 @@
+"""JAX-aware static analysis: the ``dsst lint`` subsystem.
+
+Eight rules over one shared AST parse per file — three migrated from
+the ad-hoc ``scripts/check_*.py`` lints, five new JAX/runtime-aware
+checkers (trace-safety, retrace-hazard, host-sync, lock-discipline,
+telemetry-registry). See :mod:`.core` for the framework (suppressions,
+baseline, renderers, exit codes) and :mod:`.checkers` for the rules.
+
+Entry points: ``dsst lint`` (CLI), :func:`run_lint` (tier-1 test and
+script shims), :func:`lint_text` (fixture tests).
+"""
+
+from .core import (
+    DEFAULT_BASELINE,
+    Checker,
+    FileContext,
+    Finding,
+    LintResult,
+    LintUsageError,
+    checker_catalog,
+    checker_names,
+    lint_text,
+    load_baseline,
+    register_checker,
+    run_lint,
+    write_baseline,
+)
+
+__all__ = [
+    "Checker",
+    "DEFAULT_BASELINE",
+    "FileContext",
+    "Finding",
+    "LintResult",
+    "LintUsageError",
+    "checker_catalog",
+    "checker_names",
+    "lint_text",
+    "load_baseline",
+    "register_checker",
+    "run_lint",
+    "write_baseline",
+]

@@ -1,0 +1,16 @@
+"""Checker plugins. Importing this package registers every rule.
+
+Three migrated from the ad-hoc ``scripts/check_*.py`` lints (thin shims
+remain at the old paths), five new JAX/runtime-aware rules.
+"""
+
+from . import (  # noqa: F401
+    bare_except,
+    fault_sites,
+    host_sync,
+    lock_discipline,
+    no_print,
+    retrace_hazard,
+    telemetry_registry,
+    trace_safety,
+)

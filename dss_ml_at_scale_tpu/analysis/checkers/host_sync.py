@@ -1,0 +1,116 @@
+"""host-sync: no device synchronization inside ``# dsst: hotpath`` code.
+
+PR 5's entire win — input stall from 30% to <10% of step time — came
+from keeping the step loop's per-batch cost to one ``queue.get``. A
+single ``.block_until_ready()``, ``.item()``, ``float(device_val)``, or
+``np.asarray(device_val)`` on that path silently re-serializes host and
+device: the call blocks until the in-flight program finishes, turning
+async dispatch back into lockstep. These regressions don't fail tests
+(the numbers stay right) — only a profile or this checker catches them.
+
+Mark latency-critical code with ``# dsst: hotpath`` on (or directly
+above) a ``def``/``for``/``while`` line; the whole body is then
+checked. Marked today: the trainer step loop, the feeder thread +
+consumer pop, the serving decode/batcher threads, and the serving
+score path. Deliberate syncs (a throttled metrics fetch, a profiler
+stop) carry ``# dsst: ignore[host-sync] reason`` where they happen.
+
+Flagged inside hot code: ``.block_until_ready()``, ``.item()``,
+``jax.device_get``/``device_get``, ``np.asarray``/``np.array``/
+``np.copy`` calls, ``float()``/``int()``/``bool()`` of a non-literal,
+and ``.copy_to_host``/``.addressable_data`` reads.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name
+from ..core import Checker, FileContext, Finding, register_checker
+
+_SYNC_METHODS = {"block_until_ready", "item", "copy_to_host",
+                 "addressable_data"}
+_SYNC_CALLS = {"device_get"}
+_NP_MODULES = {"np", "numpy", "onp"}
+_NP_SYNC_ATTRS = {"asarray", "array", "copy"}
+_HOST_CASTS = {"float", "int", "bool"}
+_HOT_STMTS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.For, ast.While)
+
+
+@register_checker
+class HostSyncChecker(Checker):
+    name = "host-sync"
+    description = (
+        "no .block_until_ready()/.item()/float()/np.asarray/device_get "
+        "inside functions or loops marked `# dsst: hotpath`"
+    )
+    roots = ("package",)
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        # Dedupe across nested marks: a marked loop inside a marked
+        # function must report each sync call once, not once per
+        # enclosing mark (duplicates would also mint two baseline keys
+        # for one defect via the occurrence index).
+        seen: set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _HOT_STMTS) and ctx.is_hotpath_marked(node):
+                scan: list[ast.AST] = []
+                if isinstance(node, (ast.For, ast.While)):
+                    scan.extend(node.body + node.orelse)
+                    # The loop header runs every iteration too — a
+                    # `while not flag.item():` syncs per step.
+                    scan.append(
+                        node.test if isinstance(node, ast.While)
+                        else node.iter
+                    )
+                else:
+                    scan.extend(node.body)
+                for stmt in scan:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call) and id(sub) not in seen:
+                            seen.add(id(sub))
+                            f = self._check_call(ctx, sub)
+                            if f is not None:
+                                out.append(f)
+        return out
+
+    def _check_call(self, ctx: FileContext,
+                    node: ast.Call) -> Finding | None:
+        name = call_name(node)
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _SYNC_METHODS:
+                return self.finding(
+                    ctx, node.lineno,
+                    f".{node.func.attr}() in a hotpath — blocks until the "
+                    "in-flight device program finishes; move it off the "
+                    "hot loop or make the value ride telemetry "
+                    "asynchronously",
+                )
+            if (
+                isinstance(node.func.value, ast.Name)
+                and node.func.value.id in _NP_MODULES
+                and node.func.attr in _NP_SYNC_ATTRS
+            ):
+                return self.finding(
+                    ctx, node.lineno,
+                    f"np.{node.func.attr}() in a hotpath — device→host "
+                    "transfer serializes with dispatch; keep data on "
+                    "device or stage it on the feeder thread",
+                )
+        if name in _SYNC_CALLS:
+            return self.finding(
+                ctx, node.lineno,
+                "device_get() in a hotpath — synchronous device→host "
+                "copy; fetch off the hot loop",
+            )
+        if name in _HOST_CASTS and node.args and not isinstance(
+            node.args[0], ast.Constant
+        ):
+            return self.finding(
+                ctx, node.lineno,
+                f"{name}() of a computed value in a hotpath — if the "
+                "argument is a device array this is a blocking scalar "
+                "fetch; hoist it or suppress with a reason",
+            )
+        return None

@@ -1,0 +1,224 @@
+"""retrace-hazard: patterns that compile more than once per program.
+
+A jit cache hit requires the SAME function object and hashable, stable
+static arguments. Three AST-visible ways to lose that bet:
+
+- ``jit(...)`` **inside a loop**: every iteration wraps a fresh callable
+  (or at best re-looks-up the cache); with a lambda or closure the cache
+  key is new each time, so every iteration pays a full XLA compile.
+- ``jit(lambda ...)`` **inside a function**: the lambda object is
+  recreated per call of the enclosing function — each call compiles
+  again and the old executable leaks in the cache.
+- **unbounded caches minting compiled artifacts**:
+  ``@lru_cache(maxsize=None)`` / ``@functools.cache`` on a factory that
+  builds ``jit``/``custom_vjp``/``pallas_call`` ops, or whose
+  parameters look like array dims — the exact shape-keyed leak
+  ``ops/fused_matmul.py`` shipped (one custom_vjp op per distinct M)
+  until PR 5 moved the dim to a traced operand. Each cached entry pins
+  an executable and its HBM constants forever.
+
+``jit`` calls with unhashable-literal static args (a ``list``/``dict``
+passed where a static is declared) are flagged too — those raise at
+call time on newer jax and silently retrace on older.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import ancestors, call_name
+from ..core import Checker, FileContext, Finding, register_checker
+
+_JIT_NAMES = {"jit", "pjit"}
+_OP_FACTORIES = {"jit", "pjit", "custom_vjp", "pallas_call", "shard_map",
+                 "shard_map_unchecked"}
+_CACHE_NAMES = {"lru_cache", "cache"}
+# Parameter names that smell like array dimensions — the cache key that
+# grows without bound as shapes vary.
+_SHAPE_PARAMS = {"m", "n", "k", "b", "shape", "shapes", "dim", "dims",
+                 "size", "sizes", "rows", "cols", "batch", "batch_size",
+                 "length", "seq_len", "width", "height"}
+
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+def _declared_statics(call: ast.Call) -> tuple[set, set]:
+    """Literal static_argnums/static_argnames on a jit wrap call."""
+    nums: set = set()
+    names: set = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            vals = (
+                kw.value.elts if isinstance(kw.value, ast.Tuple)
+                else [kw.value]
+            )
+            nums = {
+                v.value for v in vals
+                if isinstance(v, ast.Constant) and isinstance(v.value, int)
+            }
+        elif kw.arg == "static_argnames":
+            vals = (
+                kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            names = {
+                v.value for v in vals
+                if isinstance(v, ast.Constant) and isinstance(v.value, str)
+            }
+    return nums, names
+
+
+def _is_unbounded_cache_decorator(dec: ast.expr) -> bool:
+    """``@functools.cache``, ``@lru_cache(maxsize=None)``. A bare
+    ``@lru_cache`` or ``@lru_cache()`` defaults to maxsize=128 —
+    bounded, fine."""
+    name = call_name(dec) if isinstance(dec, ast.Call) else None
+    if isinstance(dec, (ast.Name, ast.Attribute)):
+        from ..astutil import dotted_name
+
+        dotted = dotted_name(dec)
+        return bool(dotted) and dotted.split(".")[-1] == "cache"
+    if name == "cache":
+        return True
+    if name == "lru_cache":
+        for kw in dec.keywords:
+            if kw.arg == "maxsize" and (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None
+            ):
+                return True
+        if dec.args and isinstance(dec.args[0], ast.Constant) and (
+            dec.args[0].value is None
+        ):
+            return True
+    return False
+
+
+@register_checker
+class RetraceHazardChecker(Checker):
+    name = "retrace-hazard"
+    description = (
+        "jit-in-loop, jit(lambda) per call, unhashable static args, and "
+        "unbounded caches minting compiled ops (shape-keyed leaks)"
+    )
+    roots = ("package",)
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        parents = ctx.parents
+        # names bound to jitted callables with declared statics:
+        # name -> (static_argnums, static_argnames)
+        jitted: dict[str, tuple[set, set]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and (
+                isinstance(node.value, ast.Call)
+                and call_name(node.value) in _JIT_NAMES
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                statics = _declared_statics(node.value)
+                if statics != (set(), set()):
+                    jitted[node.targets[0].id] = statics
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and call_name(node) in _JIT_NAMES:
+                out.extend(self._check_jit_call(ctx, node, parents))
+            elif isinstance(node, ast.Call):
+                out.extend(self._check_static_args(ctx, node, jitted))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_cached_factory(ctx, node))
+        return out
+
+    def _check_static_args(self, ctx, node: ast.Call,
+                           jitted: dict) -> list[Finding]:
+        """Unhashable literals passed at a declared-static position of a
+        locally known jitted callable: cache-miss (or TypeError) on
+        every call."""
+        if not isinstance(node.func, ast.Name) or node.func.id not in jitted:
+            return []
+        nums, names = jitted[node.func.id]
+        out = []
+        for i, arg in enumerate(node.args):
+            if i in nums and isinstance(arg, _UNHASHABLE):
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"unhashable literal at static_argnums position {i} "
+                    f"of jitted {node.func.id!r} — statics are cache keys; "
+                    "pass a tuple/frozen value or make the arg traced",
+                ))
+        for kw in node.keywords:
+            if kw.arg in names and isinstance(kw.value, _UNHASHABLE):
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"unhashable literal for static_argname {kw.arg!r} of "
+                    f"jitted {node.func.id!r} — statics are cache keys; "
+                    "pass a tuple/frozen value or make the arg traced",
+                ))
+        return out
+
+    def _check_jit_call(self, ctx, node: ast.Call, parents) -> list[Finding]:
+        out = []
+        in_loop = any(
+            isinstance(a, (ast.For, ast.While, ast.AsyncFor))
+            for a in ancestors(node, parents)
+        )
+        if in_loop:
+            out.append(self.finding(
+                ctx, node.lineno,
+                "jit() called inside a loop — every iteration re-wraps "
+                "(and with a fresh callable, re-COMPILES); hoist the jit "
+                "out of the loop",
+            ))
+        if node.args and isinstance(node.args[0], ast.Lambda):
+            in_function = any(
+                isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                for a in ancestors(node, parents)
+            )
+            if in_function and not in_loop:
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    "jit(lambda ...) inside a function — the lambda is a "
+                    "fresh cache key per call, so every call compiles; "
+                    "define the function once at module scope",
+                ))
+        return out
+
+    def _check_cached_factory(self, ctx, node) -> list[Finding]:
+        cached_line = None
+        for dec in node.decorator_list:
+            if _is_unbounded_cache_decorator(dec):
+                cached_line = dec.lineno
+                break
+        if cached_line is None:
+            return []
+        mints_ops = any(
+            isinstance(n, ast.Call) and call_name(n) in _OP_FACTORIES
+            for n in ast.walk(node)
+        )
+        params = [
+            a.arg for a in (
+                node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+            )
+        ]
+        shape_keyed = sorted(
+            p for p in params if p.lower() in _SHAPE_PARAMS
+        )
+        if not (mints_ops or shape_keyed):
+            return []
+        detail = []
+        if mints_ops:
+            detail.append(
+                "the body builds jit/custom_vjp/pallas ops, so every "
+                "cache entry pins a compiled executable"
+            )
+        if shape_keyed:
+            detail.append(
+                f"parameter(s) {', '.join(shape_keyed)} look like array "
+                "dims — a shape-keyed unbounded cache (the old "
+                "fused_matmul per-M leak)"
+            )
+        return [self.finding(
+            ctx, node.lineno,
+            f"unbounded cache on {node.name!r}: " + "; ".join(detail)
+            + " — bound maxsize or key on a closed config set",
+        )]

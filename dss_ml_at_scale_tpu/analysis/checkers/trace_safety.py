@@ -1,0 +1,295 @@
+"""trace-safety: no Python control flow / host ops on traced values.
+
+Inside a ``jit``/``pjit``/``shard_map``/``custom_vjp``-wrapped function
+every non-static argument is a tracer: ``if x > 0``, ``while err >
+tol``, ``bool(x)``, ``float(x)``, and ``np.*(x)`` either raise
+``TracerBoolConversionError`` at trace time or — worse — silently bake
+one branch into the compiled program. The fix is always the same
+family: ``lax.cond`` / ``lax.while_loop`` / ``jnp.where`` / ``lax.*``
+primitives. XLA cannot diagnose this for us (the failure mode that
+*compiles* is the dangerous one), so the checker does.
+
+Detection is a conservative per-function taint pass:
+
+- a function counts as traced when it is decorated with
+  ``jit``/``pjit``/``custom_vjp`` (directly or via
+  ``partial(jax.jit, ...)``), or wrapped by name in a
+  ``jit(f)``/``pjit(f)``/``shard_map(f, ...)`` call in the same file;
+- its parameters are tainted, EXCEPT names bound by
+  ``static_argnums``/``static_argnames``/``nondiff_argnums`` (literal
+  values only — non-literal static specs are invisible to the AST and
+  simply widen the taint, erring toward reporting);
+- taint propagates through assignments; ``.shape``/``.ndim``/
+  ``.dtype``/``.size`` access, ``len()``, ``np.shape()``/``np.ndim()``,
+  ``x is None`` tests, and ``isinstance()`` are *static under trace*
+  and launder taint.
+
+Flagged: ``if``/``while``/``for`` over a live tainted value,
+``bool()``/``float()``/``int()`` of one, and ``np.*``/``numpy.*`` calls
+receiving one.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, dotted_name
+from ..core import Checker, FileContext, Finding, register_checker
+
+_WRAPPERS = {"jit", "pjit", "custom_vjp", "shard_map",
+             "shard_map_unchecked"}
+_PARTIAL = {"partial"}
+# Attribute access that is static under trace: reading it off a tracer
+# yields a Python value, so control flow on it is fine.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+_STATIC_CALLS = {"len", "isinstance", "shape", "ndim", "result_type",
+                 "issubdtype", "type"}
+_HOST_CASTS = {"bool", "float", "int"}
+_NP_MODULES = {"np", "numpy", "onp"}
+_NP_OK_ATTRS = {"shape", "ndim", "dtype", "result_type", "issubdtype"}
+
+
+def _static_names_from_call(call: ast.Call) -> tuple[set[int], set[str]]:
+    """Literal static_argnums/static_argnames/nondiff_argnums."""
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "nondiff_argnums"):
+            vals = (
+                kw.value.elts if isinstance(kw.value, ast.Tuple)
+                else [kw.value]
+            )
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    nums.add(v.value)
+        elif kw.arg == "static_argnames":
+            vals = (
+                kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.add(v.value)
+    return nums, names
+
+
+def _wrapper_call_info(call: ast.Call) -> tuple[bool, set[int], set[str]]:
+    """Is this call a jit-family wrapper, and with what statics?"""
+    name = call_name(call)
+    if name in _WRAPPERS:
+        nums, names = _static_names_from_call(call)
+        return True, nums, names
+    return False, set(), set()
+
+
+class _TaintedUse(ast.NodeVisitor):
+    """Collect live (unlaundered) uses of tainted names in an expression."""
+
+    def __init__(self, taint: set[str]):
+        self.taint = taint
+        self.live: list[ast.Name] = []
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id in self.taint:
+            self.live.append(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in _STATIC_ATTRS:
+            return  # x.shape / x.ndim / ... launder the taint
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if name in _STATIC_CALLS:
+            return  # len(x), isinstance(x, T), np.shape(x), ...
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        # `x is None` / `x is not None` on an optional arg is idiomatic
+        # and trace-safe (the tracer's *identity*, not its value).
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) and (
+            any(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in [node.left, *node.comparators]
+            )
+        ):
+            return
+        self.generic_visit(node)
+
+
+def _live_uses(expr: ast.expr, taint: set[str]) -> list[ast.Name]:
+    v = _TaintedUse(taint)
+    v.visit(expr)
+    return v.live
+
+
+def _bound_names(target: ast.expr) -> list[str]:
+    out = []
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name):
+            out.append(node.id)
+    return out
+
+
+class _TracedBody(ast.NodeVisitor):
+    """One traced function body: propagate taint, flag violations."""
+
+    def __init__(self, checker: Checker, ctx: FileContext,
+                 taint: set[str]):
+        self.checker = checker
+        self.ctx = ctx
+        self.taint = taint
+        # Names bound to Python list/tuple displays: HOST containers.
+        # A `for` over one is a static trace-time unroll (idiomatic:
+        # `for start in [hr, ar, zeros]:`), unlike a `for` over a
+        # traced array, which is the data-dependent-iteration hazard.
+        self.host_containers: set[str] = set()
+        self.findings: list[Finding] = []
+
+    def _flag(self, node: ast.AST, what: str, names: list[ast.Name]) -> None:
+        ids = sorted({n.id for n in names})
+        self.findings.append(self.checker.finding(
+            self.ctx, node.lineno,
+            f"{what} on traced value(s) {', '.join(ids)} inside a "
+            "jit/pjit/shard_map/custom_vjp function — use lax.cond/"
+            "lax.while_loop/jnp.where (or mark the argument static)",
+        ))
+
+    # -- taint propagation -------------------------------------------------
+
+    def _assign(self, targets: list[ast.expr], value: ast.expr | None):
+        if value is None:
+            return
+        if isinstance(value, (ast.List, ast.Tuple, ast.ListComp)):
+            for t in targets:
+                self.host_containers.update(_bound_names(t))
+        if _live_uses(value, self.taint):
+            for t in targets:
+                self.taint.update(_bound_names(t))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._assign(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._assign([node.target], node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._assign([node.target], node.value)
+        self.generic_visit(node)
+
+    # -- violations --------------------------------------------------------
+
+    def visit_If(self, node: ast.If) -> None:
+        live = _live_uses(node.test, self.taint)
+        if live:
+            self._flag(node, "Python `if`", live)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        live = _live_uses(node.test, self.taint)
+        if live:
+            self._flag(node, "Python `while`", live)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        live = _live_uses(node.iter, self.taint)
+        iter_is_host = (
+            isinstance(node.iter, (ast.List, ast.Tuple))
+            or (
+                isinstance(node.iter, ast.Name)
+                and node.iter.id in self.host_containers
+            )
+        )
+        if live and not iter_is_host:
+            self._flag(node, "Python `for` iteration", live)
+        if live:
+            # The loop variable holds (an element of) the traced value
+            # either way.
+            self.taint.update(_bound_names(node.target))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        args_live = [
+            n for a in node.args for n in _live_uses(a, self.taint)
+        ]
+        if name in _HOST_CASTS and args_live:
+            self._flag(node, f"host cast `{name}()`", args_live)
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in _NP_MODULES
+            and node.func.attr not in _NP_OK_ATTRS
+            and args_live
+        ):
+            self._flag(
+                node, f"host numpy call `np.{node.func.attr}()`", args_live
+            )
+        self.generic_visit(node)
+
+
+@register_checker
+class TraceSafetyChecker(Checker):
+    name = "trace-safety"
+    description = (
+        "no Python if/while/bool()/float()/np.* on values derived from "
+        "traced args inside jit/pjit/shard_map/custom_vjp functions"
+    )
+    roots = ("package",)
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        # Functions wrapped by name somewhere in the file:
+        # name -> (static_argnums, static_argnames)
+        wrapped: dict[str, tuple[set[int], set[str]]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_wrap, nums, names = _wrapper_call_info(node)
+            if is_wrap and node.args and isinstance(node.args[0], ast.Name):
+                wrapped[node.args[0].id] = (nums, names)
+
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            statics = self._decorator_statics(node)
+            if statics is None and node.name in wrapped:
+                statics = wrapped[node.name]
+            if statics is None:
+                continue
+            nums, names = statics
+            params = [a.arg for a in (
+                node.args.posonlyargs + node.args.args
+            )]
+            taint = {
+                p for i, p in enumerate(params)
+                if i not in nums and p not in names
+            }
+            taint.update(
+                a.arg for a in node.args.kwonlyargs if a.arg not in names
+            )
+            taint.discard("self")
+            body = _TracedBody(self, ctx, taint)
+            for stmt in node.body:
+                body.visit(stmt)
+            findings.extend(body.findings)
+        return findings
+
+    def _decorator_statics(
+        self, node: ast.FunctionDef | ast.AsyncFunctionDef
+    ) -> tuple[set[int], set[str]] | None:
+        for dec in node.decorator_list:
+            name = dotted_name(dec)
+            if name and name.split(".")[-1] in _WRAPPERS:
+                return set(), set()
+            if isinstance(dec, ast.Call):
+                callee = call_name(dec)
+                if callee in _WRAPPERS:
+                    return _static_names_from_call(dec)
+                if callee in _PARTIAL and dec.args:
+                    inner = dotted_name(dec.args[0])
+                    if inner and inner.split(".")[-1] in _WRAPPERS:
+                        return _static_names_from_call(dec)
+        return None

@@ -1928,6 +1928,102 @@ def _print_snapshot_table(snapshot: dict) -> None:
         print(f"{name:<{width}}  {kind:<9}  {value}")
 
 
+def register_lint(sub: argparse._SubParsersAction) -> None:
+    ln = sub.add_parser(
+        "lint",
+        help="run the JAX-aware static-analysis suite (trace-safety, "
+        "retrace hazards, host-sync-in-hotpath, lock discipline, "
+        "registries) over the package",
+    )
+    ln.add_argument(
+        "--rules", default=None, metavar="R1,R2",
+        help="comma-separated subset of rules to run (default: all; "
+        "see --list-rules)",
+    )
+    ln.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output (schema documented in README "
+        "'Static analysis'; stable across versions via its 'version' "
+        "field) instead of text",
+    )
+    ln.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="baseline file of accepted pre-existing findings "
+        "(default: LINT_BASELINE.json at the repo root)",
+    )
+    ln.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline to the current findings: existing "
+        "entries keep their authored reason, new ones take --reason, "
+        "stale ones are dropped",
+    )
+    ln.add_argument(
+        "--reason", default=None, metavar="TEXT",
+        help="justification recorded for entries newly added by "
+        "--update-baseline (mandatory when any exist)",
+    )
+    ln.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    ln.set_defaults(fn=_cmd_lint)
+
+
+def _cmd_lint(args: argparse.Namespace) -> int:
+    from ..analysis import (
+        DEFAULT_BASELINE,
+        LintUsageError,
+        checker_catalog,
+        load_baseline,
+        run_lint,
+        write_baseline,
+    )
+
+    try:
+        if args.list_rules:
+            for name, desc in checker_catalog():
+                print(f"{name:20s} {desc}")
+            return 0
+        rules = (
+            [r.strip() for r in args.rules.split(",") if r.strip()]
+            if args.rules else None
+        )
+        baseline = (
+            Path(args.baseline) if args.baseline else DEFAULT_BASELINE
+        )
+        res = run_lint(rules, baseline_path=baseline)
+        if args.update_baseline:
+            # Everything currently reported (active + already-baselined)
+            # becomes the new baseline; stale keys simply don't survive
+            # the rewrite. Entries of rules OUTSIDE this run's selection
+            # are preserved verbatim — a --rules subset update must not
+            # wipe what it never re-checked.
+            old = load_baseline(baseline)
+            selected = set(res.rules) | {"suppression"}
+            preserved = {
+                k: e for k, e in old.items()
+                if e.get("rule") not in selected
+            }
+            added = write_baseline(
+                baseline, res.findings + res.baselined, old, args.reason,
+                preserved=preserved,
+            )
+            print(
+                f"baseline {baseline}: {len(res.findings)} added "
+                f"({added} with new reason), {len(res.baselined)} kept, "
+                f"{len(preserved)} preserved (other rules), "
+                f"{len(res.stale_baseline)} stale dropped"
+            )
+            return 0
+        print(res.render_json() if args.json else res.render_text())
+        # Exit codes are part of the CI contract: 0 clean, 1 findings
+        # (or stale baseline ballast), 2 usage error.
+        return res.exit_code
+    except LintUsageError as e:
+        print(f"dsst lint: {e}", file=sys.stderr)
+        return 2
+
+
 def register_all(sub: argparse._SubParsersAction) -> None:
     register_datagen(sub)
     register_forecast(sub)
@@ -1944,6 +2040,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_quarantine(sub)
     register_runs(sub)
     register_telemetry(sub)
+    register_lint(sub)
     from .pipeline import register_pipeline
 
     register_pipeline(sub)

@@ -146,6 +146,7 @@ class Feeder:
 
     # -- producer (feeder thread) -----------------------------------------
 
+    # dsst: hotpath — feeder-thread stage cost is what overlaps step dispatch
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
@@ -183,6 +184,7 @@ class Feeder:
     def __iter__(self) -> Iterator[tuple[Any, Any]]:
         return self
 
+    # dsst: hotpath — the consumer's entire per-batch cost: one queue.get
     def __next__(self) -> tuple[Any, Any]:
         if self._done:
             raise StopIteration

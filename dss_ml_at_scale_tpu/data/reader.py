@@ -416,6 +416,7 @@ class ParquetShardReader:
             # raised here is actionable (workers are daemon threads).
             try:
                 self.stop()
+            # dsst: ignore[bare-except] generator finalizer at interpreter shutdown: nothing raised here is actionable
             except BaseException:
                 pass
 

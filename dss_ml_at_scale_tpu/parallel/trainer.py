@@ -613,6 +613,7 @@ class Trainer:
                     # simply re-runs the restored span. Mid-epoch resume
                     # falls out of the same arithmetic.
                     epoch_end_step = (epoch + 1) * steps_per_epoch
+                    # dsst: hotpath — per-step cost budget is one queue.get (host-sync lint enforces it)
                     while step < epoch_end_step:
                         # One queue.get: the feeder already staged,
                         # sharded, and enqueued the batch (and accounted
@@ -652,12 +653,14 @@ class Trainer:
                             step_timer.tick()
                             compiles.update()
                             if tracing and step >= trace_stop_at:
+                                # dsst: ignore[host-sync] profiler stop: one deliberate sync when the trace window closes
                                 jax.block_until_ready(state.params)
                                 jax.profiler.stop_trace()
                                 tracing = False
                                 cfg = dataclasses.replace(cfg, profile_dir=None)
                             if step % cfg.log_every_steps == 0:
                                 self._log(
+                                    # dsst: ignore[host-sync] deliberate scalar fetch, throttled to log_every_steps
                                     {k: float(v) for k, v in metrics.items()},
                                     step,
                                 )

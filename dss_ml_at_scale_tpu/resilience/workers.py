@@ -33,6 +33,12 @@ log = logging.getLogger(__name__)
 class WorkerPool:
     """Thread-safe pool of worker identities with drop/heartbeat/readmit."""
 
+    # Lint contract (dsst lint, lock-discipline rule): these attributes
+    # are shared across trial threads, heartbeat probers, and the
+    # sweep's waiter — every access outside __init__ must hold _cond.
+    _guarded_by_lock = ("_idle", "_live", "_probing", "_closed", "_threads")
+    _lock_name = "_cond"
+
     def __init__(
         self,
         workers: Iterable,
@@ -121,17 +127,25 @@ class WorkerPool:
             )
             if start_probe:
                 self._probing.add(worker)
+                t = threading.Thread(
+                    target=self._heartbeat, args=(worker, cooldown),
+                    daemon=True, name=f"worker-heartbeat-{worker}",
+                )
+                # Prune finished heartbeats so a flappy worker doesn't
+                # grow the list one dead Thread per drop/readmit cycle.
+                # Under _cond: two trial threads dropping workers
+                # concurrently both rebuilt this list, and the loser's
+                # append vanished — a heartbeat thread close() never
+                # joined (found by the lock-discipline lint).
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
+                # Started INSIDE the lock: a close() racing this drop
+                # must never snapshot (and join) a not-yet-started
+                # Thread — that join raises RuntimeError. The heartbeat
+                # body waits on _closed_event first, so starting it
+                # while holding _cond cannot deadlock.
+                t.start()
             self._cond.notify_all()
-        if start_probe:
-            t = threading.Thread(
-                target=self._heartbeat, args=(worker, cooldown), daemon=True,
-                name=f"worker-heartbeat-{worker}",
-            )
-            # Prune finished heartbeats so a flappy worker doesn't grow
-            # the list one dead Thread per drop/readmit cycle.
-            self._threads = [x for x in self._threads if x.is_alive()]
-            self._threads.append(t)
-            t.start()
 
     def readmit(self, worker) -> None:
         with self._cond:
@@ -176,7 +190,10 @@ class WorkerPool:
             self._closed = True
             self._probing.clear()
             self._cond.notify_all()
+            # Snapshot under the lock, join OUTSIDE it: a heartbeat's
+            # loop re-checks _probing under _cond, so joining while
+            # holding it would deadlock against the thread being joined.
+            threads, self._threads = self._threads, []
         self._closed_event.set()
-        for t in self._threads:
+        for t in threads:
             t.join(timeout=2.0)
-        self._threads = []

@@ -117,6 +117,11 @@ class MeshBatchPlacer:
     # leak, and evicting oldest keeps the cache harmless anyway.
     _MAX_PLANS = 128
 
+    # Lint contract (dsst lint, lock-discipline rule): the sharding memo
+    # and plan cache are shared between the feeder thread and the
+    # training thread (eval); every access outside __init__ holds _lock.
+    _guarded_by_lock = ("_shardings", "_plans")
+
     def __init__(self, mesh: Mesh, axis: str = "data", specs=None):
         self.mesh = mesh
         self.axis = axis
@@ -126,8 +131,10 @@ class MeshBatchPlacer:
         self._plans: dict = {}      # (treedef, shapes) -> [NamedSharding]
 
     def _sharding(self, spec) -> NamedSharding:
+        # dsst: ignore[lock-discipline] plan-construction helper: reached only from __call__ with _lock already held
         s = self._shardings.get(spec)
         if s is None:
+            # dsst: ignore[lock-discipline] same — __call__ holds _lock across plan construction
             s = self._shardings[spec] = NamedSharding(self.mesh, spec)
         return s
 
@@ -208,9 +215,16 @@ class MeshBatchPlacer:
         key = (treedef, tuple(np.shape(x) for _, x in flat))
         with self._lock:
             shardings = self._plans.get(key)
-        if shardings is None:
-            shardings = [self._leaf_sharding(p, x) for p, x in flat]
-            with self._lock:
+            if shardings is None:
+                # Plan construction happens UNDER the lock: it walks and
+                # mutates the _shardings memo, and this instance is
+                # documented thread-safe (feeder thread + training
+                # thread for eval) — the old build-outside-then-insert
+                # raced the memo dict (found by the lock-discipline
+                # lint). Construction is cheap host work (validation +
+                # NamedSharding objects) and runs once per distinct
+                # batch structure; nothing is cached when it raises.
+                shardings = [self._leaf_sharding(p, x) for p, x in flat]
                 if len(self._plans) >= self._MAX_PLANS:
                     self._plans.pop(next(iter(self._plans)))
                 self._plans[key] = shardings

@@ -78,6 +78,12 @@ class Request:
     __slots__ = ("n", "deadline", "t_admit", "results", "error",
                  "_remaining", "_done", "_lock")
 
+    # Lint contract (dsst lint, lock-discipline rule): settlement state
+    # is written by whichever worker thread ends the request — always
+    # under _lock. (Readers outside this class consume it only after
+    # the _done event, which publishes the writes.)
+    _guarded_by_lock = ("results", "error", "_remaining")
+
     def __init__(self, n: int, deadline: float | None = None):
         self.n = n
         self.deadline = deadline  # absolute time.monotonic(), or None
@@ -147,6 +153,10 @@ class WorkItem:
 
 class AdmissionController:
     """The bounded gate: at most ``depth`` images pending at once."""
+
+    # Lint contract: HTTP handler threads admit, worker threads release,
+    # the batcher feeds the service-rate EWMA — all under _lock.
+    _guarded_by_lock = ("_pending", "_seconds_per_image")
 
     def __init__(self, depth: int, on_depth=None):
         if depth < 1:

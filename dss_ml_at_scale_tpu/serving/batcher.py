@@ -69,6 +69,7 @@ class DecodePool:
         for t in self._threads:
             t.join(timeout)
 
+    # dsst: hotpath — decode must overlap device scoring, never sync with it
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
@@ -123,6 +124,7 @@ class Batcher:
     def join(self, timeout: float = 2.0) -> None:
         self._thread.join(timeout)
 
+    # dsst: hotpath — batch assembly sits between admission and the scorer
     def _gather(self, first) -> list:
         """``first`` plus whatever arrives before full-or-window."""
         batch = [first]
@@ -137,6 +139,7 @@ class Batcher:
                 break
         return batch
 
+    # dsst: hotpath — ONE batcher thread feeds the compiled scorer
     def _run(self) -> None:
         while not self._stop.is_set():
             try:

@@ -32,6 +32,11 @@ STOPPED = "stopped"
 class Lifecycle:
     """Thread-safe STARTING → READY → DRAINING → STOPPED progression."""
 
+    # Lint contract: state transitions race between the serve thread,
+    # handler threads, and the SIGTERM/drain path — _state only under
+    # _lock.
+    _guarded_by_lock = ("_state",)
+
     def __init__(self):
         self._lock = threading.Lock()
         self._state = STARTING

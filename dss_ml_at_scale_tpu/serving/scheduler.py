@@ -283,6 +283,7 @@ class ServingScheduler:
         for item in items:
             self._retire(item)
 
+    # dsst: hotpath — the serving score path: every admitted image crosses here
     def _run_batch(self, items: list) -> None:
         now = time.monotonic()
         for item in items:

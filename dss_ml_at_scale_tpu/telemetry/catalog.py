@@ -1,0 +1,71 @@
+"""The metric-name catalog: every series the package may emit.
+
+The registry is get-or-create by design (call sites never coordinate),
+which means a typo'd name silently forks a series and a renamed metric
+silently orphans its dashboard. This catalog is the single place metric
+names are *declared*; the ``telemetry-registry`` lint rule
+(``dsst lint``) holds call sites to it in both directions — every
+literal name used with ``counter()``/``gauge()``/``histogram()`` in the
+package must appear here with the matching kind, and every entry here
+must still have a call site. Mirrors ``resilience.faults.KNOWN_SITES``
+(the ``fault-sites`` rule) exactly.
+
+Adding a metric: add the call site AND the entry here (the lint fails
+on either alone). Removing one: remove both.
+"""
+
+from __future__ import annotations
+
+# name -> kind ("counter" | "gauge" | "histogram")
+KNOWN_METRICS: dict[str, str] = {
+    # -- checkpointing / resilience ---------------------------------------
+    "checkpoint_fallback_total": "counter",
+    "faults_injected_total": "counter",
+    "health_rollbacks_total": "counter",
+    "loss_spikes_total": "counter",
+    "nonfinite_steps_total": "counter",
+    "preemption_signals_total": "counter",
+    "quarantined_batches_total": "counter",
+    "retry_total": "counter",
+    "worker_readmitted_total": "counter",
+    # -- device / compile --------------------------------------------------
+    "device_hbm_bytes_in_use": "gauge",
+    "device_hbm_bytes_limit": "gauge",
+    "device_hbm_bytes_peak": "gauge",
+    "device_live_buffers": "gauge",
+    "device_memory_stats_supported": "gauge",
+    "device_monitor_samples_total": "counter",
+    "jit_compile_events_total": "counter",
+    # -- input pipeline ----------------------------------------------------
+    "corrupt_samples_total": "counter",
+    "feeder_batches_total": "counter",
+    "feeder_depth": "gauge",
+    "feeder_occupancy": "gauge",
+    "feeder_stage_seconds": "histogram",
+    "feeder_stall_seconds_total": "counter",
+    "ingest_bytes_total": "counter",
+    "ingest_rows_total": "counter",
+    "reader_queue_depth": "gauge",
+    "reader_stall_seconds_total": "counter",
+    # -- training / HPO ----------------------------------------------------
+    "hpo_trials_total": "counter",
+    "pipeline_utilization": "gauge",
+    "train_compile_events_total": "counter",
+    "train_data_wait_seconds": "histogram",
+    "train_step_seconds": "histogram",
+    "train_throughput_rows_per_sec": "gauge",
+    # -- serving -----------------------------------------------------------
+    "predict_batch_seconds": "histogram",
+    "predict_errors_total": "counter",
+    "predict_images_total": "counter",
+    "scoring_nonfinite_total": "counter",
+    "serving_admission_rejected_total": "counter",
+    "serving_batch_fill": "histogram",
+    "serving_batches_total": "counter",
+    "serving_deadline_expired_total": "counter",
+    "serving_errors_total": "counter",
+    "serving_queue_depth": "gauge",
+    "serving_ready": "gauge",
+    "serving_request_seconds": "histogram",
+    "serving_time_in_queue_seconds": "histogram",
+}

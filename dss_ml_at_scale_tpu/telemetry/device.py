@@ -121,7 +121,8 @@ class DeviceMonitor:
         while not self._stop.wait(self.interval_s):
             try:
                 self.sample()
-            except Exception:  # a flaky backend must not kill the thread
+            # dsst: ignore[bare-except] sampler thread: a flaky backend must not kill it
+            except Exception:
                 pass
 
     def start(self) -> "DeviceMonitor":

@@ -59,6 +59,10 @@ class _CounterValue:
 
     __slots__ = ("_lock", "value")
 
+    # Lint contract (dsst lint, lock-discipline rule): hot-path writers
+    # from every thread family hit these; mutation only under _lock.
+    _guarded_by_lock = ("value",)
+
     def __init__(self):
         self._lock = threading.Lock()
         self.value = 0.0
@@ -74,6 +78,7 @@ class _CounterValue:
             self.value = 0.0
 
     def _sample(self) -> dict:
+        # dsst: ignore[lock-discipline] lock-free approximate read: render paths tolerate a torn float; never written here
         return {"value": self.value}
 
 
@@ -81,6 +86,8 @@ class _GaugeValue:
     """One gauge series."""
 
     __slots__ = ("_lock", "value")
+
+    _guarded_by_lock = ("value",)
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -102,6 +109,7 @@ class _GaugeValue:
             self.value = 0.0
 
     def _sample(self) -> dict:
+        # dsst: ignore[lock-discipline] lock-free approximate read: render paths tolerate a torn float; never written here
         return {"value": self.value}
 
 
@@ -109,6 +117,9 @@ class _HistogramValue:
     """One histogram series: per-bucket counts + sum + count."""
 
     __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+
+    # buckets is immutable after construction and deliberately unlisted.
+    _guarded_by_lock = ("counts", "sum", "count")
 
     def __init__(self, buckets: Sequence[float]):
         self._lock = threading.Lock()
@@ -189,6 +200,8 @@ class MetricFamily:
     per event).
     """
 
+    _guarded_by_lock = ("_children",)
+
     def __init__(self, kind: str, name: str, help: str = "",
                  label_names: Sequence[str] = (), buckets=None):
         self.kind = kind
@@ -266,6 +279,8 @@ class MetricFamily:
 
 class MetricsRegistry:
     """Get-or-create registry of metric families, one per process."""
+
+    _guarded_by_lock = ("_families",)
 
     def __init__(self):
         self._lock = threading.Lock()

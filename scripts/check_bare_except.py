@@ -1,90 +1,36 @@
 #!/usr/bin/env python
-"""Lint: no swallowed errors in the library or scripts.
+"""Thin shim — this lint moved into the analysis subsystem.
 
-Swallowed exceptions are how robustness bugs hide: a retry loop that
-"works" because the failure it should surface is eaten two frames down
-is worse than no retry at all. Two patterns are banned:
-
-- bare ``except:`` — catches ``KeyboardInterrupt``/``SystemExit`` too,
-  which no library code here should ever intend;
-- silent broad handlers — ``except Exception:`` / ``except
-  BaseException:`` (alone or in a tuple) whose entire body is ``pass``
-  (or a docstring + ``pass``); catching broadly is sometimes right, but
-  then the handler must DO something: log, count, re-wrap, or fall back.
-
-The allowlist maps a file to the number of audited, comment-justified
-silent handlers it may keep; adding a new one anywhere else (or a new
-one in an allowlisted file) fails tier-1 via
-``tests/test_no_bare_except.py``.
+The rule now lives at
+:mod:`dss_ml_at_scale_tpu.analysis.checkers.bare_except` (rule name
+``bare-except``) and runs with the whole suite via ``dsst lint`` and
+``tests/test_lint.py``. The old file→count allowlist became in-source
+``# dsst: ignore[bare-except] reason`` suppressions at the audited
+sites. This shim keeps the old entry point alive for external
+references.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parents[1]
-SCAN_DIRS = ("dss_ml_at_scale_tpu", "scripts")
-
-# path (relative to repo root) -> max audited silent broad handlers.
-# Every entry must carry an in-source comment justifying the swallow.
-ALLOWED_SILENT = {
-    # DeviceMonitor sampler thread: a flaky backend must not kill it.
-    "dss_ml_at_scale_tpu/telemetry/device.py": 1,
-    # Reader generator finalizer at interpreter shutdown: nothing raised
-    # there is actionable.
-    "dss_ml_at_scale_tpu/data/reader.py": 1,
-}
-
-_BROAD = {"Exception", "BaseException"}
-
-
-def _is_broad(expr: ast.expr | None) -> bool:
-    if expr is None:
-        return True  # bare except
-    if isinstance(expr, ast.Name):
-        return expr.id in _BROAD
-    if isinstance(expr, ast.Tuple):
-        return any(_is_broad(e) for e in expr.elts)
-    return False
-
-
-def _is_silent(handler: ast.ExceptHandler) -> bool:
-    body = handler.body
-    if body and isinstance(body[0], ast.Expr) and isinstance(
-        getattr(body[0], "value", None), ast.Constant
-    ):
-        body = body[1:]  # skip a docstring-style leading constant
-    return all(isinstance(stmt, ast.Pass) for stmt in body)
+sys.path.insert(0, str(ROOT))
 
 
 def find_violations(root: Path = ROOT) -> list[str]:
-    violations: list[str] = []
-    for scan in SCAN_DIRS:
-        for path in sorted((root / scan).rglob("*.py")):
-            rel = path.relative_to(root).as_posix()
-            tree = ast.parse(path.read_text(encoding="utf-8"),
-                             filename=str(path))
-            silent_broad = 0
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.ExceptHandler):
-                    continue
-                if node.type is None:
-                    violations.append(
-                        f"{rel}:{node.lineno}: bare `except:` — name the "
-                        "exceptions (or Exception) you actually mean"
-                    )
-                elif _is_broad(node.type) and _is_silent(node):
-                    silent_broad += 1
-                    if silent_broad > ALLOWED_SILENT.get(rel, 0):
-                        violations.append(
-                            f"{rel}:{node.lineno}: silent broad except "
-                            "(body is just `pass`) — log, count, or "
-                            "narrow it; swallowed errors hide robustness "
-                            "bugs"
-                        )
-    return violations
+    from dss_ml_at_scale_tpu.analysis import run_lint
+
+    root = Path(root)
+    res = run_lint(
+        ["bare-except"],
+        roots=[
+            ("package", root / "dss_ml_at_scale_tpu"),
+            ("scripts", root / "scripts"),
+        ],
+    )
+    return [f.text() for f in res.findings]
 
 
 def main() -> int:

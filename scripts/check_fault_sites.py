@@ -1,150 +1,37 @@
 #!/usr/bin/env python
-"""Lint: fault-injection sites cannot drift from their registry.
+"""Thin shim — this lint moved into the analysis subsystem.
 
-Every ``maybe_fail("...")`` / ``fault_fires("...")`` call site in the
-library is part of the chaos-testing surface operators arm with
-``--fault-plan`` — so every site name used in the package must be
-declared (with a description) in ``resilience.faults.KNOWN_SITES``, and
-every declared site must still have a call site. Otherwise injection
-sites silently drift from the docs and the CLI help (which is generated
-from the same dict), and a chaos plan arms nothing.
-
-Rules (AST-based, so comments/strings never false-positive):
-
-- a site argument must be a string literal, or an f-string whose
-  *leading literal prefix* (e.g. ``f"rpc.send.{method}"`` → ``rpc.send``)
-  matches a registered site — dynamic suffixes are how per-method RPC
-  sites work;
-- a bare variable argument is allowed only inside a function that is
-  itself a registered marker (``maybe_fail``/``fault_fires`` wrappers
-  forwarding their parameter, e.g. ``runtime.rpc._maybe_fail``);
-- every ``KNOWN_SITES`` key must be used by at least one call site and
-  carry a non-empty description.
-
-Runs in tier-1 via ``tests/test_fault_sites.py``.
+The rule now lives at
+:mod:`dss_ml_at_scale_tpu.analysis.checkers.fault_sites` (rule name
+``fault-sites``) and runs with the whole suite via ``dsst lint`` and
+``tests/test_lint.py``. This shim keeps the old entry point (and
+``find_violations(package, known=...)`` signature) alive for external
+references.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-PACKAGE = Path(__file__).resolve().parents[1] / "dss_ml_at_scale_tpu"
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
 
-# Call names that mark an injection site. Wrapper functions carrying one
-# of these names may forward a variable site argument.
-MARKERS = {"maybe_fail", "fault_fires", "_maybe_fail", "check", "fires"}
-
-
-def _known_sites() -> dict:
-    # Import the live registry — the lint must test what ships, not a
-    # copy that could itself drift.
-    sys.path.insert(0, str(PACKAGE.parent))
-    try:
-        from dss_ml_at_scale_tpu.resilience.faults import KNOWN_SITES
-    finally:
-        sys.path.pop(0)
-    return KNOWN_SITES
-
-
-def _call_name(node: ast.Call) -> str | None:
-    if isinstance(node.func, ast.Name):
-        return node.func.id
-    if isinstance(node.func, ast.Attribute):
-        return node.func.attr
-    return None
-
-
-def _site_literal(arg: ast.expr) -> tuple[str | None, bool]:
-    """``(site, is_prefix)`` from the argument node, or ``(None, False)``
-    when it is not a (partially) literal string."""
-    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-        return arg.value, False
-    if isinstance(arg, ast.JoinedStr):
-        prefix = ""
-        for part in arg.values:
-            if isinstance(part, ast.Constant) and isinstance(part.value, str):
-                prefix += part.value
-            else:
-                break
-        return (prefix.rstrip(".") or None), True
-    return None, False
-
-
-def _registered(site: str, is_prefix: bool, known: dict) -> bool:
-    for key in known:
-        if site == key or site.startswith(key + "."):
-            return True
-        if is_prefix and key.startswith(site + "."):
-            return True
-    return False
+PACKAGE = ROOT / "dss_ml_at_scale_tpu"
 
 
 def find_violations(package: Path = PACKAGE,
                     known: dict | None = None) -> list[str]:
-    known = _known_sites() if known is None else known
-    violations: list[str] = []
-    used: list[tuple[str, bool]] = []
-    for path in sorted(package.rglob("*.py")):
-        rel = path.relative_to(package)
-        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
-        # Map each call to its innermost enclosing function name, so
-        # forwarding wrappers can be recognized.
-        parents: dict[ast.AST, str | None] = {}
+    from dss_ml_at_scale_tpu.analysis import run_lint
+    from dss_ml_at_scale_tpu.analysis.checkers.fault_sites import (
+        FaultSitesChecker,
+    )
 
-        def assign_parents(node, fn=None):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                fn = node.name
-            for child in ast.iter_child_nodes(node):
-                parents[child] = fn
-                assign_parents(child, fn)
-
-        assign_parents(tree)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            name = _call_name(node)
-            if name not in ("maybe_fail", "fault_fires", "_maybe_fail"):
-                continue
-            if not node.args:
-                continue
-            site, is_prefix = _site_literal(node.args[0])
-            if site is None:
-                if (
-                    isinstance(node.args[0], ast.Name)
-                    and parents.get(node) in MARKERS
-                ):
-                    continue  # a wrapper forwarding its site parameter
-                violations.append(
-                    f"{rel}:{node.lineno}: {name}() with a non-literal "
-                    "site — use a string literal (or f-string with a "
-                    "registered prefix) so the site registry can see it"
-                )
-                continue
-            used.append((site, is_prefix))
-            if not _registered(site, is_prefix, known):
-                violations.append(
-                    f"{rel}:{node.lineno}: site {site!r} is not registered "
-                    "in resilience.faults.KNOWN_SITES — declare and "
-                    "document it there"
-                )
-    for key, doc in known.items():
-        if not (isinstance(doc, str) and doc.strip()):
-            violations.append(
-                f"KNOWN_SITES[{key!r}] has no description — document "
-                "what arming it simulates"
-            )
-        if not any(
-            site == key or site.startswith(key + ".")
-            or (is_prefix and key.startswith(site + "."))
-            for site, is_prefix in used
-        ):
-            violations.append(
-                f"KNOWN_SITES[{key!r}] has no call site left in the "
-                "package — remove the entry or restore the site"
-            )
-    return violations
+    res = run_lint(
+        roots=[("package", Path(package))],
+        checkers=[FaultSitesChecker(known=known)],
+    )
+    return [f.text() for f in res.findings]
 
 
 def main() -> int:

@@ -1,47 +1,31 @@
 #!/usr/bin/env python
-"""Lint: no bare ``print(`` inside the library.
+"""Thin shim — this lint moved into the analysis subsystem.
 
-Every user-facing line must flow through an accountable channel —
-telemetry (metered), tracking (archived), or ``logging`` (filterable).
-A bare ``print`` in library code bypasses all three and corrupts
-machine-parseable CLI stdout. The CLI surface (``config/``: cli,
-commands, pipeline — whose *job* is stdout) is the one exemption.
-
-AST-based so strings, comments, and ``pprint``-style names never false
-positive; ``file=sys.stderr`` prints in library code are violations too
-(use logging). Runs in tier-1 via ``tests/test_no_print.py``.
+The rule now lives at
+:mod:`dss_ml_at_scale_tpu.analysis.checkers.no_print` (rule name
+``no-print``) and runs with the whole suite via ``dsst lint`` and
+``tests/test_lint.py``. This shim keeps the old entry point (and
+``find_violations()`` signature) alive for external references.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-PACKAGE = Path(__file__).resolve().parents[1] / "dss_ml_at_scale_tpu"
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
 
-# The CLI surface: stdout is its contract.
-ALLOWED_FIRST_PARTS = {"config"}
+PACKAGE = ROOT / "dss_ml_at_scale_tpu"
 
 
 def find_violations(package: Path = PACKAGE) -> list[str]:
-    violations: list[str] = []
-    for path in sorted(package.rglob("*.py")):
-        rel = path.relative_to(package)
-        if rel.parts[0] in ALLOWED_FIRST_PARTS:
-            continue
-        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"
-            ):
-                violations.append(
-                    f"{rel}:{node.lineno}: bare print() — route through "
-                    "telemetry/tracking/logging"
-                )
-    return violations
+    from dss_ml_at_scale_tpu.analysis import run_lint
+
+    res = run_lint(
+        ["no-print"], roots=[("package", Path(package))]
+    )
+    return [f.text() for f in res.findings]
 
 
 def main() -> int:

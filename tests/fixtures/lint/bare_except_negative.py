@@ -1,0 +1,18 @@
+"""Fixture: narrow or acting handlers — no findings."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def careful(x):
+    try:
+        x = 1
+    except ValueError:
+        pass  # narrow: fine
+    try:
+        y = 2
+    except Exception as e:
+        log.warning("recovered: %s", e)  # broad but ACTS: fine
+        y = 0
+    return x, y

@@ -1,0 +1,18 @@
+"""Fixture: 3 bare-except findings (bare, silent broad, silent tuple)."""
+
+
+def swallow(x):
+    try:
+        x = 1
+    except:  # noqa: E722
+        raise
+    try:
+        y = 2
+    except Exception:
+        pass
+    try:
+        z = 3
+    except (ValueError, BaseException):
+        """docstring-style constant then pass is still silent"""
+        pass
+    return x, y, z

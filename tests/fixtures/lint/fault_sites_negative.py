@@ -1,0 +1,10 @@
+"""Fixture (known={"rpc.send": "transport"}): forwarding wrapper and
+f-string prefix — no findings."""
+
+
+def _maybe_fail(site):
+    maybe_fail(site)  # forwarding wrapper: allowed
+
+
+def send(method):
+    _maybe_fail(f"rpc.send.{method}")

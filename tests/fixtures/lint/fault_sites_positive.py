@@ -1,0 +1,9 @@
+"""Fixture (known={"reader.next": "doc"}): 3 findings — unregistered
+site, non-literal site outside a wrapper, dead registry key."""
+
+from resilience.faults import maybe_fail
+
+
+def f(site):
+    maybe_fail("totally.new.site")
+    maybe_fail(site)  # non-literal outside a registered wrapper name

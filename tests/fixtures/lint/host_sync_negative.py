@@ -1,0 +1,25 @@
+"""Fixture: hot code that stays async — no findings."""
+
+import queue
+import time
+
+
+# dsst: hotpath
+def feeder_run(source, place, out_q):
+    for raw in source:
+        t0 = time.perf_counter()
+        device_batch = place(raw)        # async dispatch: fine
+        out_q.put((device_batch, time.perf_counter() - t0))
+
+
+def consume(q):
+    # dsst: hotpath — loop-level mark
+    while True:
+        try:
+            item = q.get(timeout=0.1)    # queue wait is not a device sync
+        except queue.Empty:
+            continue
+        if item is None:
+            break
+        n = int(3)                       # literal cast: fine
+    return n

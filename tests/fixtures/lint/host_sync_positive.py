@@ -1,0 +1,22 @@
+"""Fixture: 5 host-sync findings inside marked hotpaths."""
+
+import jax
+import numpy as np
+
+
+# dsst: hotpath
+def step_loop(feeder, state, train_step):
+    for batch in feeder:
+        state, metrics = train_step(state, batch)
+        jax.block_until_ready(state)       # sync in a hotpath
+        loss = metrics["loss"].item()      # scalar fetch
+        host = np.asarray(metrics["acc"])  # device->host transfer
+        snap = jax.device_get(state)       # synchronous copy
+        rate = float(metrics["rate"])      # blocking cast
+    return state, loss, host, snap, rate
+
+
+def epoch_end(state):
+    # Unmarked function: syncing here is fine (and correct).
+    jax.block_until_ready(state)
+    return np.asarray(state)

@@ -1,0 +1,40 @@
+"""Fixture: disciplined shared state — no findings."""
+
+import threading
+
+_CACHE: dict = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def put(key, value):
+    with _CACHE_LOCK:
+        _CACHE[key] = value
+
+
+class Pool:
+    _guarded_by_lock = ("_items", "_closed")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []         # __init__ is exempt (happens-before)
+        self._closed = False
+
+    def put(self, item):
+        with self._lock:
+            if not self._closed:
+                self._items.append(item)
+
+    def drain(self):
+        with self._lock:
+            items, self._items = self._items, []
+        return items
+
+
+class Unannotated:
+    """No _guarded_by_lock declaration: not checked (opt-in contract)."""
+
+    def __init__(self):
+        self.items = []
+
+    def put(self, item):
+        self.items.append(item)

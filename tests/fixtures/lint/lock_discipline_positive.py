@@ -1,0 +1,41 @@
+"""Fixture: 4 lock-discipline findings (2 class-attr, 2 module-global)."""
+
+import threading
+
+_CACHE: dict = {}
+_lock = threading.Lock()
+
+
+def put_unlocked(key, value):
+    _CACHE[key] = value          # module global mutated without the lock
+
+
+def evict_unlocked(key):
+    _CACHE.pop(key, None)        # same
+
+
+def put_locked(key, value):
+    with _lock:
+        _CACHE[key] = value      # correct: held
+
+
+class Pool:
+    _guarded_by_lock = ("_items", "_closed")
+    _lock_name = "_cond"
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+        self._closed = False
+
+    def get(self):
+        with self._cond:
+            if self._items:
+                return self._items.pop()
+        return None
+
+    def put(self, item):
+        self._items.append(item)     # guarded attr outside the lock
+
+    def close(self):
+        self._closed = True          # same

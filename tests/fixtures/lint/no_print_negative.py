@@ -1,0 +1,11 @@
+"""Fixture: accountable channels only — no findings."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def quiet(x):
+    log.info("value: %s", x)
+    pprint = repr  # a name *containing* print must not trip the rule
+    return pprint(x)

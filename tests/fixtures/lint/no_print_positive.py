@@ -1,0 +1,8 @@
+"""Fixture: 2 no-print findings."""
+
+
+def chatty(x):
+    print("value:", x)
+    if x:
+        print(x)
+    return x

@@ -1,0 +1,33 @@
+"""Fixture: cache-friendly jit usage — no findings."""
+
+import functools
+
+import jax
+
+
+def double(v):
+    return v * 2
+
+
+double_jit = jax.jit(double)           # module-scope wrap: one cache entry
+
+
+@functools.lru_cache(maxsize=8)        # bounded: fine
+def make_schedule(kind):
+    return {"kind": kind}
+
+
+@functools.lru_cache(maxsize=None)     # unbounded but mints no ops and
+def lookup(key):                       # no shape-like params: fine
+    return {"a": 1}.get(key)
+
+
+def kernel(x, dims):
+    return x
+
+
+kernel_jit = jax.jit(kernel, static_argnames=("dims",))
+
+
+def call_it(x):
+    return kernel_jit(x, dims=(1, 2))  # hashable static: fine

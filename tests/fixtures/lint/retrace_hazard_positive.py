@@ -1,0 +1,35 @@
+"""Fixture: 5 retrace-hazard findings (jit-in-loop, jit(lambda) ×2,
+unbounded shape-keyed cache, unhashable static arg)."""
+
+import functools
+
+import jax
+
+
+def per_step(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)   # jit() inside a loop
+        outs.append(f(x))
+    return outs
+
+
+def per_call(x):
+    g = jax.jit(lambda v: v + 1)       # jit(lambda) per call of per_call
+    return g(x)
+
+
+@functools.lru_cache(maxsize=None)
+def make_op(m, n):                     # unbounded cache keyed on dims
+    return jax.jit(lambda a: a.reshape(m, n))
+
+
+def kernel(x, dims):
+    return x
+
+
+kernel_jit = jax.jit(kernel, static_argnames=("dims",))
+
+
+def call_it(x):
+    return kernel_jit(x, dims=[1, 2])  # unhashable static argument

@@ -1,0 +1,14 @@
+"""Fixture (known={"requests_total": "counter", "depth": "gauge"}):
+declared names with matching kinds, plus a forwarding facade — no
+findings."""
+
+from dss_ml_at_scale_tpu import telemetry
+
+
+def counter(name, help=""):
+    return telemetry.counter(name, help)    # forwarder: variable ok
+
+
+def instrument():
+    telemetry.counter("requests_total").inc()
+    telemetry.gauge("depth").set(3)

@@ -1,0 +1,12 @@
+"""Fixture (known={"requests_total": "counter", "dead_gauge": "gauge"}):
+4 findings — undeclared name, kind mismatch, non-literal name, dead
+registry entry."""
+
+from dss_ml_at_scale_tpu import telemetry
+
+
+def instrument(name):
+    telemetry.counter("request_total")      # typo: not declared
+    telemetry.gauge("requests_total")       # declared as counter
+    telemetry.counter(name)                 # non-literal outside facade
+    telemetry.counter("requests_total")     # fine (keeps the entry live)

@@ -1,0 +1,43 @@
+"""Fixture: all idiomatic trace-safe patterns — no findings."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def safe(x, y=None):
+    if y is None:                 # identity test: trace-safe
+        y = jnp.zeros_like(x)
+    if x.ndim > 2:                # shape/ndim/dtype are static
+        x = x.reshape(x.shape[0], -1)
+    n = len(x.shape)
+    for i in range(x.ndim):       # static-ranged loop
+        x = x + i
+    z = jnp.where(x > 0, x, -x)   # the lax way to branch on values
+    return z, n, y
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def static_branch(mode, x):
+    if mode == "relu":            # static arg: Python branching is fine
+        return jnp.maximum(x, 0.0)
+    return x
+
+
+@jax.jit
+def unrolled(x, y):
+    starts = [x, y, x * y]
+    acc = jnp.zeros_like(x)
+    for s in starts:              # host list of tracers: static unroll
+        acc = acc + s
+    return acc
+
+
+def plain(x):
+    # Not jitted anywhere: host control flow is host control flow.
+    if x > 0:
+        return float(x)
+    return np.sum(x)

@@ -1,0 +1,33 @@
+"""Fixture: 5 trace-safety findings (if, while, bool, float, np.sum)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def branchy(x, y):
+    z = x + y
+    if z > 0:               # Python `if` on a traced value
+        y = -y
+    while x < 1.0:          # Python `while` on a traced value
+        x = x + 0.1
+    flag = bool(y)          # host cast of a traced value
+    return z, flag
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def leaky(cfg, x):
+    v = float(x)            # host cast of a traced value
+    s = np.sum(x)           # host numpy on a traced value
+    return v + s
+
+
+def wrapped(a, b):
+    c = a * b
+    return c
+
+
+wrapped_jit = jax.jit(wrapped)

@@ -1,63 +1,5 @@
-"""Tier-1 face of scripts/check_fault_sites.py: every fault-injection
-site used in the package is registered/documented in
-resilience.faults.KNOWN_SITES, and no registered site is dead."""
+"""Migrated into the ``dsst lint`` suite — see tests/test_lint.py
+(rule ``fault-sites``). Kept as an import so external references break
+neither collection nor muscle memory."""
 
-import importlib.util
-from pathlib import Path
-
-import pytest
-
-
-def _load_linter():
-    path = (
-        Path(__file__).resolve().parents[1]
-        / "scripts" / "check_fault_sites.py"
-    )
-    spec = importlib.util.spec_from_file_location("check_fault_sites", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-def test_fault_sites_registry_matches_call_sites():
-    linter = _load_linter()
-    violations = linter.find_violations()
-    assert violations == [], "\n".join(violations)
-
-
-@pytest.fixture()
-def linter():
-    return _load_linter()
-
-
-def test_lint_flags_unregistered_site(tmp_path, linter):
-    (tmp_path / "mod.py").write_text(
-        "from resilience.faults import maybe_fail\n"
-        'maybe_fail("totally.new.site")\n'
-    )
-    violations = linter.find_violations(tmp_path, known={"reader.next": "x"})
-    assert len(violations) == 2  # unregistered site + dead registry key
-    assert "totally.new.site" in violations[0]
-    assert "reader.next" in violations[1]
-
-
-def test_lint_flags_non_literal_site_outside_wrappers(tmp_path, linter):
-    (tmp_path / "mod.py").write_text(
-        "def f(site):\n"
-        "    maybe_fail(site)\n"  # not a registered wrapper name
-    )
-    violations = linter.find_violations(tmp_path, known={})
-    assert violations and "non-literal" in violations[0]
-
-
-def test_lint_allows_fstring_prefix_and_forwarding_wrapper(tmp_path, linter):
-    (tmp_path / "mod.py").write_text(
-        "def _maybe_fail(site):\n"
-        "    maybe_fail(site)\n"     # forwarding wrapper: allowed
-        "def send(method):\n"
-        '    _maybe_fail(f"rpc.send.{method}")\n'
-    )
-    violations = linter.find_violations(
-        tmp_path, known={"rpc.send": "transport"}
-    )
-    assert violations == [], "\n".join(violations)
+from test_lint import test_fault_sites_clean  # noqa: F401

@@ -1,0 +1,515 @@
+"""Tier-1 face of the ``dsst lint`` static-analysis subsystem.
+
+Three layers:
+
+- **the real gate**: the full 8-rule suite over the shipped package must
+  be clean against the committed baseline (zero unbaselined findings,
+  zero stale entries, every baseline entry justified);
+- **per-rule fixtures**: positive/negative snippets under
+  ``tests/fixtures/lint/`` prove each checker bites what it claims and
+  spares the idioms it must spare;
+- **framework semantics**: suppression parsing (reason mandatory) and
+  baseline add/expire.
+
+``test_no_print.py`` / ``test_no_bare_except.py`` / ``test_fault_sites.py``
+are one-line imports of the per-rule gates here, so external references
+to those files keep working.
+"""
+
+from __future__ import annotations
+
+import functools
+from pathlib import Path
+
+import pytest
+
+from dss_ml_at_scale_tpu.analysis import (
+    LintUsageError,
+    lint_text,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from dss_ml_at_scale_tpu.analysis.checkers.bare_except import (
+    BareExceptChecker,
+)
+from dss_ml_at_scale_tpu.analysis.checkers.fault_sites import (
+    FaultSitesChecker,
+)
+from dss_ml_at_scale_tpu.analysis.checkers.host_sync import HostSyncChecker
+from dss_ml_at_scale_tpu.analysis.checkers.lock_discipline import (
+    LockDisciplineChecker,
+)
+from dss_ml_at_scale_tpu.analysis.checkers.no_print import NoPrintChecker
+from dss_ml_at_scale_tpu.analysis.checkers.retrace_hazard import (
+    RetraceHazardChecker,
+)
+from dss_ml_at_scale_tpu.analysis.checkers.telemetry_registry import (
+    TelemetryRegistryChecker,
+)
+from dss_ml_at_scale_tpu.analysis.checkers.trace_safety import (
+    TraceSafetyChecker,
+)
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "lint"
+
+
+# -- the real gate: the shipped package is lint-clean -------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def _full_result():
+    """ONE whole-package scan shared by the full gate and the per-rule
+    gates (including their re-imports from the migrated test files) —
+    the package is parsed once per tier-1 run, not seven times."""
+    return run_lint()
+
+
+def test_full_suite_clean_against_baseline():
+    res = _full_result()
+    assert res.findings == [], "\n".join(f.text() for f in res.findings)
+    assert res.stale_baseline == [], (
+        "stale baseline entries (findings fixed but ballast kept): "
+        + ", ".join(e["key"] for e in res.stale_baseline)
+    )
+
+
+def test_every_baseline_entry_has_a_reason():
+    from dss_ml_at_scale_tpu.analysis import DEFAULT_BASELINE
+
+    entries = load_baseline(DEFAULT_BASELINE)
+    for key, entry in entries.items():
+        assert str(entry.get("reason", "")).strip(), (
+            f"baseline entry {key} has no reason"
+        )
+
+
+def _rule_clean(rule: str):
+    bad = [f for f in _full_result().findings if f.rule == rule]
+    assert bad == [], "\n".join(f.text() for f in bad)
+
+
+def test_no_print_clean():
+    _rule_clean("no-print")
+
+
+def test_no_bare_except_clean():
+    _rule_clean("bare-except")
+
+
+def test_fault_sites_clean():
+    _rule_clean("fault-sites")
+
+
+# -- per-rule fixtures --------------------------------------------------------
+
+# rule -> (checker factory, expected positive finding count)
+RULES = {
+    "no_print": (lambda: NoPrintChecker(), 2),
+    "bare_except": (lambda: BareExceptChecker(), 3),
+    "fault_sites_pos": (
+        lambda: FaultSitesChecker(known={"reader.next": "doc"}), 3,
+    ),
+    "fault_sites_neg": (
+        lambda: FaultSitesChecker(known={"rpc.send": "transport"}), None,
+    ),
+    "trace_safety": (lambda: TraceSafetyChecker(), 5),
+    "retrace_hazard": (lambda: RetraceHazardChecker(), 5),
+    "host_sync": (lambda: HostSyncChecker(), 5),
+    "lock_discipline": (lambda: LockDisciplineChecker(), 4),
+    "telemetry_registry_pos": (
+        lambda: TelemetryRegistryChecker(
+            known={"requests_total": "counter", "dead_gauge": "gauge"}
+        ), 4,
+    ),
+    "telemetry_registry_neg": (
+        lambda: TelemetryRegistryChecker(
+            known={"requests_total": "counter", "depth": "gauge"}
+        ), None,
+    ),
+}
+
+
+def _fixture(name: str) -> str:
+    return (FIXTURES / f"{name}.py").read_text(encoding="utf-8")
+
+
+@pytest.mark.parametrize(
+    "rule", [r for r, (_, n) in RULES.items() if n is not None]
+)
+def test_rule_flags_positive_fixture(rule):
+    factory, expected = RULES[rule]
+    base = rule.removesuffix("_pos")
+    findings = lint_text(factory(), _fixture(f"{base}_positive"))
+    texts = "\n".join(f.text() for f in findings)
+    assert len(findings) == expected, (
+        f"expected {expected} findings, got {len(findings)}:\n{texts}"
+    )
+
+
+@pytest.mark.parametrize(
+    "rule", [r for r in RULES if not r.endswith("_pos")]
+)
+def test_rule_spares_negative_fixture(rule):
+    factory, _ = RULES[rule]
+    base = rule.removesuffix("_pos").removesuffix("_neg")
+    findings = lint_text(factory(), _fixture(f"{base}_negative"))
+    assert findings == [], "\n".join(f.text() for f in findings)
+
+
+# -- suppression semantics ----------------------------------------------------
+
+
+def test_suppression_silences_with_reason():
+    src = (
+        "def f(x):\n"
+        "    print(x)  # dsst: ignore[no-print] CLI-adjacent debug shim\n"
+    )
+    assert lint_text(NoPrintChecker(), src) == []
+
+
+def test_suppression_on_line_above():
+    src = (
+        "def f(x):\n"
+        "    # dsst: ignore[no-print] annotates the next line\n"
+        "    print(x)\n"
+    )
+    assert lint_text(NoPrintChecker(), src) == []
+
+
+def test_suppression_wrong_rule_does_not_silence():
+    src = (
+        "def f(x):\n"
+        "    print(x)  # dsst: ignore[bare-except] wrong rule named\n"
+    )
+    findings = lint_text(NoPrintChecker(), src)
+    assert len(findings) == 1 and findings[0].rule == "no-print"
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "def f(x):\n"
+        "    print(x)  # dsst: ignore[no-print]\n"
+    )
+    res = run_lint(
+        ["no-print"],
+        roots=[("package", pkg)],
+        baseline_path=tmp_path / "baseline.json",
+    )
+    rules = sorted(f.rule for f in res.findings)
+    # The reasonless comment does NOT suppress, and is itself flagged.
+    assert rules == ["no-print", "suppression"], [
+        f.text() for f in res.findings
+    ]
+
+
+# -- baseline add / expire semantics ------------------------------------------
+
+
+def _write_violating_pkg(tmp_path: Path) -> Path:
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text("def f(x):\n    print(x)\n")
+    return pkg
+
+
+def test_baseline_add_then_clean(tmp_path):
+    pkg = _write_violating_pkg(tmp_path)
+    bl = tmp_path / "baseline.json"
+    roots = [("package", pkg)]
+    res = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    assert len(res.findings) == 1 and res.exit_code == 1
+    write_baseline(bl, res.findings, {}, "known debug print, PR pending")
+    res2 = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    assert res2.findings == [] and res2.exit_code == 0
+    assert len(res2.baselined) == 1
+
+
+def test_baseline_requires_reason_for_new_entries(tmp_path):
+    pkg = _write_violating_pkg(tmp_path)
+    bl = tmp_path / "baseline.json"
+    res = run_lint(["no-print"], roots=[("package", pkg)], baseline_path=bl)
+    with pytest.raises(LintUsageError):
+        write_baseline(bl, res.findings, {}, None)
+
+
+def test_baseline_expires_when_finding_fixed(tmp_path):
+    pkg = _write_violating_pkg(tmp_path)
+    bl = tmp_path / "baseline.json"
+    roots = [("package", pkg)]
+    res = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    write_baseline(bl, res.findings, {}, "pending")
+    # Fix the violation: the baseline entry is now stale ballast and
+    # must FAIL the run until regenerated.
+    (pkg / "mod.py").write_text("def f(x):\n    return x\n")
+    res2 = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    assert res2.findings == []
+    assert len(res2.stale_baseline) == 1
+    assert res2.exit_code == 1
+    # --update-baseline semantics: rewrite from current findings drops it.
+    write_baseline(bl, [], load_baseline(bl), None)
+    res3 = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    assert res3.exit_code == 0
+
+
+def test_baseline_reopens_when_flagged_line_edited(tmp_path):
+    pkg = _write_violating_pkg(tmp_path)
+    bl = tmp_path / "baseline.json"
+    roots = [("package", pkg)]
+    res = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    write_baseline(bl, res.findings, {}, "pending")
+    # Edit the flagged line: the content-addressed key changes, so the
+    # finding re-opens (and the old entry goes stale).
+    (pkg / "mod.py").write_text("def f(x):\n    print(x, x)\n")
+    res2 = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    assert len(res2.findings) == 1
+    assert len(res2.stale_baseline) == 1
+
+
+def test_unrelated_edits_keep_baseline_match(tmp_path):
+    pkg = _write_violating_pkg(tmp_path)
+    bl = tmp_path / "baseline.json"
+    roots = [("package", pkg)]
+    res = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    write_baseline(bl, res.findings, {}, "pending")
+    # Insert lines ABOVE the finding: line numbers shift but the key
+    # (hash of the line text, not its number) still matches.
+    (pkg / "mod.py").write_text(
+        "import logging\n\n\ndef f(x):\n    print(x)\n"
+    )
+    res2 = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    assert res2.findings == [] and res2.stale_baseline == []
+
+
+def test_stacked_suppression_comments_merge():
+    src = (
+        "def f(x):\n"
+        "    # dsst: ignore[no-print] tolerated here\n"
+        "    # dsst: ignore[bare-except] also tolerated\n"
+        "    print(x)\n"
+    )
+    # The SECOND comment's own line inherits the first's rules too, and
+    # the statement line carries both — neither clobbers the other.
+    assert lint_text(NoPrintChecker(), src) == []
+
+
+def test_shim_preserves_config_exemption_and_distinct_paths(tmp_path):
+    """scripts/check_no_print.py on a foreign tree: config/ stays
+    exempt and same-named files in different dirs stay distinct."""
+    import importlib.util
+
+    pkg = tmp_path / "somepkg"
+    (pkg / "config").mkdir(parents=True)
+    (pkg / "core").mkdir()
+    (pkg / "core2").mkdir()
+    (pkg / "config" / "cli.py").write_text("print('cli owns stdout')\n")
+    (pkg / "core" / "mod.py").write_text("print('a')\n")
+    (pkg / "core2" / "mod.py").write_text("print('b')\nprint('c')\n")
+    spec = importlib.util.spec_from_file_location(
+        "check_no_print",
+        Path(__file__).resolve().parents[1] / "scripts" / "check_no_print.py",
+    )
+    shim = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(shim)
+    lines = shim.find_violations(pkg)
+    assert len(lines) == 3 and not any("cli.py" in v for v in lines)
+    assert any(v.startswith("core/mod.py:") for v in lines)
+    assert any(v.startswith("core2/mod.py:") for v in lines)
+
+
+def test_nested_hotpath_marks_report_once():
+    src = (
+        "# dsst: hotpath\n"
+        "def hot(q):\n"
+        "    # dsst: hotpath\n"
+        "    while True:\n"
+        "        q.item()\n"
+    )
+    findings = lint_text(HostSyncChecker(), src)
+    assert len(findings) == 1, [f.text() for f in findings]
+
+
+def test_corrupt_baseline_is_a_usage_error(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text("<<<<<<< not json")
+    with pytest.raises(LintUsageError):
+        run_lint(["no-print"], baseline_path=bl)
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    assert main(["lint", "--baseline", str(bl)]) == 2
+
+
+def test_subset_update_preserves_other_rules_entries(tmp_path):
+    """--rules subset --update-baseline must not wipe entries belonging
+    to rules it never re-checked (regression: it rewrote wholesale)."""
+    import json
+    import shutil
+
+    from dss_ml_at_scale_tpu.analysis import DEFAULT_BASELINE
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    bl = tmp_path / "baseline.json"
+    shutil.copy(DEFAULT_BASELINE, bl)
+    before = load_baseline(bl)
+    assert before, "committed baseline unexpectedly empty"
+    rc = main([
+        "lint", "--rules", "no-print", "--update-baseline",
+        "--baseline", str(bl), "--reason", "unused",
+    ])
+    assert rc == 0
+    after = load_baseline(bl)
+    assert after == before, (
+        "subset update dropped entries: "
+        + json.dumps(sorted(set(before) - set(after)))
+    )
+
+
+def test_docstring_mention_of_directive_is_inert():
+    """A docstring LINE spelling the directive syntax must not mint a
+    phantom suppression/hotpath mark or a reasonless-suppression
+    finding (regression: raw-line regex scan)."""
+    src = (
+        '"""Docs.\n'
+        "\n"
+        "# dsst: ignore[no-print]\n"
+        "# dsst: hotpath\n"
+        '"""\n'
+        "\n"
+        "def f(x):\n"
+        "    print(x)\n"
+    )
+    findings = lint_text(NoPrintChecker(), src)
+    # The print() is still flagged (docstring line 3 suppressed nothing)
+    # and no 'suppression' finding appeared for the reasonless mention.
+    assert [f.rule for f in findings] == ["no-print"]
+    from dss_ml_at_scale_tpu.analysis.core import FileContext
+
+    ctx = FileContext(Path("fixture.py"), "fixture.py", "package", src)
+    assert ctx.reasonless == [] and ctx.hotpath_marks == set()
+
+
+def test_registry_level_baseline_entry_expires(tmp_path):
+    """A baselined finalize()-pass finding (path '<registry>') must go
+    stale when it disappears (regression: staleness was gated on
+    scanned file paths, which '<registry>' never is)."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text('maybe_fail("a.b")\n')
+    bl = tmp_path / "baseline.json"
+    roots = [("package", pkg)]
+    known_with_dead = {"a.b": "doc", "dead.site": "doc"}
+    res = run_lint(
+        roots=roots, baseline_path=bl,
+        checkers=[FaultSitesChecker(known=known_with_dead)],
+    )
+    assert len(res.findings) == 1  # dead.site has no call site
+    write_baseline(bl, res.findings, {}, "site lands next PR")
+    res2 = run_lint(
+        roots=roots, baseline_path=bl,
+        checkers=[FaultSitesChecker(known=known_with_dead)],
+    )
+    assert res2.findings == [] and res2.exit_code == 0
+    # The registry entry is cleaned up: the baselined finding is gone
+    # and its entry must now be reported stale.
+    res3 = run_lint(
+        roots=roots, baseline_path=bl,
+        checkers=[FaultSitesChecker(known={"a.b": "doc"})],
+    )
+    assert res3.findings == []
+    assert len(res3.stale_baseline) == 1 and res3.exit_code == 1
+
+
+def test_baseline_entry_of_deleted_file_goes_stale(tmp_path):
+    """Deleting a file must expire its baseline entries — otherwise a
+    later re-added file with the same flagged line silently inherits
+    the dead exemption (regression: staleness required a scanned
+    path)."""
+    # The fixture tree must live INSIDE the repo so run_lint can
+    # attribute entries to the scanned root by repo-relative prefix.
+    import shutil
+    import uuid
+
+    repo_tmp = (
+        Path(__file__).resolve().parents[1]
+        / f"_lint_tmp_{uuid.uuid4().hex[:8]}"
+    )
+    pkg = repo_tmp / "pkg"
+    pkg.mkdir(parents=True)
+    try:
+        (pkg / "mod.py").write_text("def f(x):\n    print(x)\n")
+        bl = tmp_path / "baseline.json"
+        roots = [("package", pkg)]
+        res = run_lint(["no-print"], roots=roots, baseline_path=bl)
+        write_baseline(bl, res.findings, {}, "pending")
+        (pkg / "mod.py").unlink()
+        res2 = run_lint(["no-print"], roots=roots, baseline_path=bl)
+        assert res2.findings == []
+        assert len(res2.stale_baseline) == 1 and res2.exit_code == 1
+    finally:
+        shutil.rmtree(repo_tmp)
+
+
+def test_hotpath_loop_header_is_checked():
+    """A sync in the marked loop's CONDITION runs every iteration and
+    must be flagged (regression: only the body was scanned)."""
+    src = (
+        "def f(done, q):\n"
+        "    # dsst: hotpath\n"
+        "    while not done.item():\n"
+        "        q.put(1)\n"
+    )
+    findings = lint_text(HostSyncChecker(), src)
+    assert len(findings) == 1 and ".item()" in findings[0].message
+
+
+def test_workerpool_concurrent_drop_close_no_crash():
+    """close() racing drop() must neither lose heartbeat threads nor
+    join an unstarted one (regression for both halves of the fix)."""
+    import threading
+
+    from dss_ml_at_scale_tpu.resilience.workers import WorkerPool
+
+    for _ in range(20):
+        pool = WorkerPool(
+            ["a", "b", "c"], probe=lambda w: None,
+            heartbeat_interval=0.01, dead_grace=0.1,
+        )
+        ts = [
+            threading.Thread(target=pool.drop, args=(w,))
+            for w in ("a", "b", "c")
+        ]
+        for t in ts:
+            t.start()
+        pool.close()  # races the drops; must not raise
+        for t in ts:
+            t.join()
+        pool.close()  # idempotent
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_lint_clean_and_json(capsys):
+    import json
+
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    assert main(["lint", "--rules", "no-print,bare-except"]) == 0
+    assert main(["lint", "--rules", "no-print", "--json"]) == 0
+    out = capsys.readouterr().out
+    payload = json.loads(out[out.index("{"):])
+    assert payload["version"] == 1 and payload["ok"] is True
+    assert main(["lint", "--rules", "not-a-rule"]) == 2
+
+
+def test_cli_lint_list_rules(capsys):
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    assert main(["lint", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("trace-safety", "retrace-hazard", "host-sync",
+                 "lock-discipline", "telemetry-registry", "no-print",
+                 "bare-except", "fault-sites"):
+        assert rule in out

@@ -1,44 +1,5 @@
-"""Tier-1 face of scripts/check_bare_except.py: no bare ``except:`` and
-no silent ``except Exception: pass`` outside the audited allowlist —
-swallowed errors are how robustness bugs hide."""
+"""Migrated into the ``dsst lint`` suite — see tests/test_lint.py
+(rule ``bare-except``). Kept as an import so external references break
+neither collection nor muscle memory."""
 
-import importlib.util
-from pathlib import Path
-
-
-def _load_linter():
-    path = (
-        Path(__file__).resolve().parents[1] / "scripts"
-        / "check_bare_except.py"
-    )
-    spec = importlib.util.spec_from_file_location("check_bare_except", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-def test_no_swallowed_errors():
-    linter = _load_linter()
-    violations = linter.find_violations()
-    assert violations == [], "\n".join(violations)
-
-
-def test_linter_flags_synthetic_violations(tmp_path):
-    """The lint actually bites: a tree with both banned patterns and a
-    justified-but-unlisted silent handler yields exactly those lines."""
-    linter = _load_linter()
-    pkg = tmp_path / "dss_ml_at_scale_tpu"
-    pkg.mkdir()
-    (tmp_path / "scripts").mkdir()
-    (pkg / "bad.py").write_text(
-        "try:\n    x = 1\nexcept:\n    raise\n"
-        "try:\n    y = 2\nexcept Exception:\n    pass\n"
-        "try:\n    z = 3\nexcept (ValueError, BaseException):\n    pass\n"
-        "try:\n    ok = 4\nexcept ValueError:\n    pass\n"  # narrow: fine
-        "try:\n    ok2 = 5\nexcept Exception as e:\n    print(e)\n"  # acts
-    )
-    violations = linter.find_violations(tmp_path)
-    assert len(violations) == 3
-    assert "bare `except:`" in violations[0]
-    assert "silent broad except" in violations[1]
-    assert "silent broad except" in violations[2]
+from test_lint import test_no_bare_except_clean  # noqa: F401

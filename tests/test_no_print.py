@@ -1,20 +1,5 @@
-"""Tier-1 face of scripts/check_no_print.py: library code must not
-print — everything goes through telemetry/tracking/logging; only the
-CLI surface (config/) owns stdout."""
+"""Migrated into the ``dsst lint`` suite — see tests/test_lint.py
+(rule ``no-print``). Kept as an import so external references break
+neither collection nor muscle memory."""
 
-import importlib.util
-from pathlib import Path
-
-
-def _load_linter():
-    path = Path(__file__).resolve().parents[1] / "scripts" / "check_no_print.py"
-    spec = importlib.util.spec_from_file_location("check_no_print", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-def test_no_bare_print_in_library():
-    linter = _load_linter()
-    violations = linter.find_violations()
-    assert violations == [], "\n".join(violations)
+from test_lint import test_no_print_clean  # noqa: F401

@@ -11,12 +11,20 @@ cd "$(dirname "$0")"
 # Round 5 started ~15:40 UTC Jul 31 with a ~12 h budget; leave the last
 # ~45 min uncontended for the driver's round-end bench.
 DEADLINE="${DSST_WATCHDOG_DEADLINE:-02:45}"
-START_DAY="$(date -u +%d)"
+# Arm the deadline as an ABSOLUTE UTC epoch, computed once at start: the
+# next occurrence of $DEADLINE (today if still ahead, else tomorrow).
+# The old day-rollover heuristic compared wall-clock strings and only
+# armed after the UTC day changed relative to script start — so a
+# watchdog *re*started just after midnight deferred a 02:45 deadline by
+# ~24h of device-lease contention (ADVICE r5).
+DEADLINE_EPOCH="$(date -u -d "today $DEADLINE" +%s)"
+if [ "$DEADLINE_EPOCH" -le "$(date -u +%s)" ]; then
+  DEADLINE_EPOCH="$(date -u -d "tomorrow $DEADLINE" +%s)"
+fi
+echo "$(date -u +%H:%M:%S) deadline armed: $DEADLINE utc (epoch $DEADLINE_EPOCH)" >> tpu_watchdog.log
 N=0
 while true; do
-  # Deadline is past-midnight relative to the round start: active only
-  # once the UTC day has rolled over.
-  if [ "$(date -u +%d)" != "$START_DAY" ] && [ "$(date -u +%H:%M)" \> "$DEADLINE" ]; then
+  if [ "$(date -u +%s)" -ge "$DEADLINE_EPOCH" ]; then
     echo "$(date -u +%H:%M:%S) deadline $DEADLINE reached - watchdog exiting" >> tpu_watchdog.log
     break
   fi
