@@ -6,6 +6,7 @@ remain at the old paths), five new JAX/runtime-aware rules.
 
 from . import (  # noqa: F401
     bare_except,
+    durable_write,
     fault_sites,
     host_sync,
     lock_discipline,

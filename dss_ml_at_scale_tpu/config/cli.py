@@ -34,7 +34,13 @@ def build_parser() -> argparse.ArgumentParser:
         "'rpc.send=2;grads.nonfinite=1@5;reader.next=p0.1;seed=7' "
         f"(sites: {', '.join(sorted(KNOWN_SITES))}; N = fail the first N "
         "hits, N@K = skip K hits then fail N, pX = seeded per-hit "
-        "probability). Default: env DSST_FAULT_PLAN; chaos testing only",
+        "probability, kN/kN@K = SIGKILL the process at the hit — the "
+        "power-cut mode dsst chaos arms at the fs.* sites: "
+        "fs.torn_write leaves a truncated staged .tmp, "
+        "fs.crash_after_tmp a complete .tmp that never publishes, "
+        "fs.fsync an EIO-style fsync failure; suffix .<kind> scopes one "
+        "publish family, e.g. fs.crash_after_tmp.manifest=k1). "
+        "Default: env DSST_FAULT_PLAN; chaos testing only",
     )
     sub = parser.add_subparsers(dest="command")
     info = sub.add_parser("info", help="show runtime topology and devices")
@@ -101,6 +107,12 @@ def main(argv: list[str] | None = None) -> int:
 
     parser = build_parser()
     args = parser.parse_args(argv)
+    # Stash the exact invocation for the run journal: what `dsst runs
+    # doctor --resume` re-executes (with --resume-auto) to revive a run
+    # this process may leave interrupted.
+    from .commands import set_invocation_argv
+
+    set_invocation_argv(argv if argv is not None else sys.argv[1:])
     fault_spec = args.fault_plan or os.environ.get("DSST_FAULT_PLAN")
     if fault_spec:
         # Armed before any subcommand work, and exported so subprocess

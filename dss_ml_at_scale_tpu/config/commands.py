@@ -461,9 +461,17 @@ def register_train(sub: argparse._SubParsersAction) -> None:
         "in the run summary) instead of stopping the epoch — lets a "
         "multi-hour run survive isolated data corruption",
     )
+    tr.add_argument(
+        "--shuffle", action=argparse.BooleanOptionalAction, default=True,
+        help="shuffle row groups per epoch (seeded); --no-shuffle gives "
+        "every table pass the identical batch order — what makes a "
+        "killed-and-auto-resumed run bitwise-reproduce an uninterrupted "
+        "one (the dsst chaos invariant)",
+    )
     tr.add_argument("--limit-val-batches", type=int, default=5)
     tr.add_argument("--checkpoint-dir", default=None)
     tr.add_argument("--resume", action="store_true")
+    _add_resume_auto_arg(tr)
     tr.add_argument("--profile-dir", default=None)
     _add_health_args(tr)
     _add_tracking_args(tr, "imagenet")
@@ -599,9 +607,14 @@ def _cmd_train(args: argparse.Namespace) -> int:
                           eval_topk=tuple(args.eval_topk))
 
     init_state = None
-    if args.pretrained and not _has_checkpoint(args):
+    if args.pretrained and (args.resume_auto or not _has_checkpoint(args)):
         # With --resume and an existing checkpoint the restore would
-        # overwrite these weights anyway — skip the conversion.
+        # overwrite these weights anyway — skip the conversion. Under
+        # --resume-auto the conversion must happen regardless: when
+        # every step on disk turns out torn, the trainer falls back to
+        # a FRESH start, and that start must be the requested
+        # pretrained weights, not a silent random init (a successful
+        # restore still overwrites them, costing only the conversion).
         if args.model.startswith("vit"):
             from ..models.pretrained import load_pretrained_vit as _load
         else:
@@ -610,6 +623,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
         variables = _load(args.pretrained, model, image_size=args.crop)
         init_state = task.state_from_variables(variables)
 
+    _mark_interrupted_predecessors(args)
     tracker = _open_tracker(args, "train")
     if tracker is not None:
         tracker.log_params(_args_params(args))
@@ -622,6 +636,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
             limit_val_batches=args.limit_val_batches,
             checkpoint_dir=args.checkpoint_dir,
             resume=args.resume,
+            resume_auto=args.resume_auto,
             profile_dir=args.profile_dir,
             shard_opt_state=args.shard_opt_state,
             feeder_depth=args.feeder_depth,
@@ -651,6 +666,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
         workers_count=args.workers,
         results_queue_size=args.queue_size,
         transform_spec=spec,
+        shuffle_row_groups=args.shuffle,
         cur_shard=topo.process_index,
         shard_count=topo.process_count,
         # Under supervision, the reader tags every batch with its row
@@ -702,6 +718,11 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 # True when a SIGTERM (spot/TPU-VM eviction) cut the run
                 # short; rerun with --resume to continue from the saved step.
                 "preempted": result.preempted,
+                # True when --resume-auto actually RESTORED a prior
+                # checkpoint (the Trainer's verdict) — False when it
+                # started fresh, including the found-only-wreckage
+                # fallback; operators must be able to trust this flag.
+                "auto_resumed": result.auto_resumed,
                 # Health-supervisor accounting (0s with --health-policy off).
                 **(
                     {
@@ -723,7 +744,10 @@ def _has_checkpoint(args: argparse.Namespace) -> bool:
     """True when --resume will actually restore something — the same
     orbax ``latest_step()`` predicate Trainer.fit uses, so the two can't
     disagree about whether a restore will happen."""
-    if not (args.resume and args.checkpoint_dir):
+    if not (
+        (args.resume or getattr(args, "resume_auto", False))
+        and args.checkpoint_dir
+    ):
         return False
     ckpt = Path(args.checkpoint_dir)
     if not ckpt.is_dir():
@@ -957,6 +981,7 @@ def register_lm(sub: argparse._SubParsersAction) -> None:
     )
     lm.add_argument("--checkpoint-dir", default=None)
     lm.add_argument("--resume", action="store_true")
+    _add_resume_auto_arg(lm)
     lm.add_argument(
         "--feeder-depth", type=int, default=2,
         help="bound of the background feeder's on-device batch queue "
@@ -1035,6 +1060,7 @@ def _cmd_lm(args: argparse.Namespace) -> int:
         aux_loss_weight=args.aux_loss_weight if args.ffn == "moe" else 0.0,
     )
 
+    _mark_interrupted_predecessors(args)
     tracker = _open_tracker(args, "lm")
     if tracker is not None:
         tracker.log_params(_args_params(args))
@@ -1048,6 +1074,7 @@ def _cmd_lm(args: argparse.Namespace) -> int:
             limit_val_batches=args.limit_val_batches,
             checkpoint_dir=args.checkpoint_dir,
             resume=args.resume,
+            resume_auto=args.resume_auto,
             feeder_depth=args.feeder_depth,
             health=health_cfg,
         ),
@@ -1164,6 +1191,13 @@ def register_hpo(sub: argparse._SubParsersAction) -> None:
         help="(--workers mode) transport-failure requeues per trial before "
         "it fails; objective exceptions are never retried",
     )
+    hp_.add_argument(
+        "--resume-auto", action="store_true",
+        help="continue a killed sweep: mark this experiment's dead "
+        "RUNNING runs INTERRUPTED (journal-based), reload the completed "
+        "trials from the newest interrupted run's journal, and run only "
+        "the remaining evals (requires tracking enabled)",
+    )
     _add_tracking_args(hp_, "hpo")
     hp_.set_defaults(fn=_cmd_hpo)
 
@@ -1220,9 +1254,71 @@ def _cmd_trial_worker(args: argparse.Namespace) -> int:
     return 0
 
 
+def _journaled_trials(root: str, experiment: str) -> list[dict]:
+    """Completed trials of ``experiment``'s interrupted runs, rebuilt
+    from their journals (``trial`` events) into the fmin store format —
+    the resume state for ``dsst hpo --resume-auto``.
+
+    Merged across ALL interrupted runs, newest first per tid: a sweep
+    killed twice leaves its early trials journaled in run A and its
+    later ones in run B, and progress must compound instead of the
+    survivor re-running (and re-journaling) what A already paid for.
+    Only the contiguous tid prefix is kept: the async pool may have
+    journaled tid 3 while tid 2 died with the process, and the driver
+    re-proposes from ``len(trials)`` — a gap would collide.
+    """
+    from ..tracking import read_journal, sweep_interrupted
+
+    if not Path(root).is_dir():
+        return []
+    report = sweep_interrupted(root, experiment)
+    candidates = sorted(
+        (c for c in report if c["effective_status"] == "INTERRUPTED"),
+        key=lambda c: c.get("start_time") or 0.0,
+        reverse=True,
+    )
+    by_tid: dict[int, dict] = {}
+    sources: list[str] = []
+    for c in candidates:
+        contributed = False
+        for e in read_journal(c["run_dir"]):
+            if e.get("event") != "trial" or int(e["tid"]) in by_tid:
+                continue
+            contributed = True
+            by_tid[int(e["tid"])] = {
+                "tid": int(e["tid"]),
+                "point": dict(e.get("point") or {}),
+                "result": {"loss": e.get("loss"),
+                           "status": e.get("status")},
+                "book_time": e.get("time"),
+                "duration": 0.0,
+            }
+        if contributed:
+            sources.append(f"{c['experiment']}/{c['run_id']}")
+    trials = []
+    for tid in range(len(by_tid)):
+        if tid not in by_tid:
+            break
+        trials.append(by_tid[tid])
+    if trials:
+        print(
+            f"hpo --resume-auto: continuing from {len(trials)} "
+            f"journaled trial(s) of {', '.join(sources)}"
+        )
+    return trials
+
+
 def _cmd_hpo(args: argparse.Namespace) -> int:
     from ..datagen.regression import gen_data, train_and_eval, tune_alpha
     from ..hpo.shipping import load_shared
+
+    resumed: list[dict] = []
+    if args.resume_auto:
+        if args.no_tracking or not args.tracking_root:
+            print("--resume-auto needs tracking enabled (the run journal "
+                  "IS the resume state)")
+            return 2
+        resumed = _journaled_trials(args.tracking_root, args.experiment)
 
     if args.workers:
         # Remote mode: objective ships by module reference, data by
@@ -1248,6 +1344,7 @@ def _cmd_hpo(args: argparse.Namespace) -> int:
             secret=_rpc_secret(args),
             max_retries=args.max_retries,
         )
+        trials.trials.extend(resumed)
         best = fmin(
             "dss_ml_at_scale_tpu.hpo.objectives:lasso_shared",
             space,
@@ -1281,9 +1378,15 @@ def _cmd_hpo(args: argparse.Namespace) -> int:
     def objective(alpha):
         return train_and_eval(data, alpha)
 
+    trials = None
+    if resumed:
+        from ..parallel import DeviceTrials
+
+        trials = DeviceTrials(parallelism=args.parallelism)
+        trials.trials.extend(resumed)
     best = tune_alpha(
         objective, parallelism=args.parallelism, max_evals=args.max_evals,
-        tracker=tracker,
+        tracker=tracker, trials=trials,
     )
     _finish_tracker(tracker, params={"mode": mode, "best_alpha": best})
     print(f"hpo ({mode}): best alpha {best:.4f}")
@@ -1325,6 +1428,16 @@ def _add_tracking_args(parser, experiment: str) -> None:
 # Ctrl-C) never lingers in RUNNING state in the run store.
 _active_tracker = None
 
+# The dsst argv of this invocation (cli.main stashes it before
+# dispatch): journaled into each run's start event so `dsst runs doctor
+# --resume` can re-execute exactly what was interrupted.
+_invocation_argv: list[str] | None = None
+
+
+def set_invocation_argv(argv: list[str] | None) -> None:
+    global _invocation_argv
+    _invocation_argv = list(argv) if argv is not None else None
+
 
 def _open_tracker(args: argparse.Namespace, run_name: str):
     """RunStore for a CLI run, or None when tracking is opted out."""
@@ -1333,8 +1446,9 @@ def _open_tracker(args: argparse.Namespace, run_name: str):
         args, "tracking_root", None
     ):
         return None
-    from ..tracking import RunStore
+    from ..tracking import RunStore, set_run_cmdline
 
+    set_run_cmdline(_invocation_argv)
     _active_tracker = RunStore(
         args.tracking_root, args.experiment, run_name=run_name
     )
@@ -1357,6 +1471,35 @@ def _args_params(args: argparse.Namespace) -> dict:
     return {
         k: v for k, v in vars(args).items() if k not in skip and v is not None
     }
+
+
+def _add_resume_auto_arg(parser) -> None:
+    parser.add_argument(
+        "--resume-auto", action="store_true",
+        help="crash-only restart: resume from the newest manifest-intact "
+        "checkpoint if one exists (falling back past torn steps, "
+        "quarantining wreckage, sweeping stranded .tmp files), else "
+        "start fresh — never errors on an empty dir and never needs a "
+        "step name. Also marks this experiment's dead RUNNING runs "
+        "INTERRUPTED (journal-based) before starting. The entry point "
+        "watchdogs (`dsst runs doctor --resume`) and the chaos soak use",
+    )
+
+
+def _mark_interrupted_predecessors(args: argparse.Namespace) -> None:
+    """--resume-auto's store hygiene: flip this experiment's dead-PID
+    RUNNING runs to INTERRUPTED before opening a new run, so the store
+    converges without waiting for an explicit doctor sweep."""
+    if not getattr(args, "resume_auto", False):
+        return
+    if getattr(args, "no_tracking", False) or not getattr(
+        args, "tracking_root", None
+    ):
+        return
+    from ..tracking import sweep_interrupted
+
+    if Path(args.tracking_root).is_dir():
+        sweep_interrupted(args.tracking_root, args.experiment)
 
 
 def _add_health_args(parser) -> None:
@@ -1799,6 +1942,31 @@ def register_runs(sub: argparse._SubParsersAction) -> None:
     sh.add_argument("--tracking-root", default=root, help=root_help)
     sh.set_defaults(fn=_cmd_runs_show)
 
+    dr = rsub.add_parser(
+        "doctor",
+        help="crash-only store sweep: classify every run from its "
+        "journal (PID + boot id), durably mark dead RUNNING runs "
+        "INTERRUPTED, clean stranded .tmp files, and report resumable "
+        "checkpoints; --resume relaunches each interrupted run's "
+        "recorded command with --resume-auto",
+    )
+    dr.add_argument("--tracking-root", default=root, help=root_help)
+    dr.add_argument("--experiment", default=None)
+    dr.add_argument(
+        "--json", action="store_true",
+        help="emit the full classification report as one JSON document",
+    )
+    dr.add_argument(
+        "--resume", action="store_true",
+        help="after the sweep, re-execute the recorded dsst command of "
+        "each interrupted run that has a resumable checkpoint (or a "
+        "journaled HPO trial log), with --resume-auto ensured — "
+        "sequentially, newest run per checkpoint dir first; what "
+        "tpu_watchdog.sh runs so a recovered TPU VM re-enters training "
+        "instead of idling",
+    )
+    dr.set_defaults(fn=_cmd_runs_doctor)
+
 
 def _cmd_runs_list(args: argparse.Namespace) -> int:
     from ..tracking import list_runs
@@ -1832,6 +2000,199 @@ def _cmd_runs_show(args: argparse.Namespace) -> int:
         print(f"no readable run {args.run} under {args.tracking_root}")
         return 1
     return 0
+
+
+def _cmd_runs_doctor(args: argparse.Namespace) -> int:
+    from ..tracking import sweep_interrupted
+
+    if not Path(args.tracking_root).is_dir():
+        print(f"no run store at {args.tracking_root}")
+        return 0
+    report = sweep_interrupted(args.tracking_root, args.experiment)
+    if args.json:
+        print(json.dumps({"root": str(args.tracking_root),
+                          "runs": report}))
+    else:
+        for cls in report:
+            line = (
+                f"{cls['experiment']}/{cls['run_id']}: "
+                f"{cls['effective_status']}"
+            )
+            if cls.get("marked"):
+                line += f" (was RUNNING, pid {cls['pid']} dead; marked)"
+            if cls.get("resumable_step") is not None:
+                line += (
+                    f" — resumable: step {cls['resumable_step']} in "
+                    f"{cls['checkpoint_dir']}"
+                )
+            print(line)
+        n_marked = sum(1 for c in report if c.get("marked"))
+        print(
+            f"{len(report)} run(s), {n_marked} newly marked INTERRUPTED, "
+            f"{sum(1 for c in report if c.get('resumable_step') is not None)}"
+            " resumable"
+        )
+    if not args.resume:
+        return 0
+    return _doctor_resume(report)
+
+
+def _doctor_resume(report: list[dict]) -> int:
+    """Re-execute interrupted runs' recorded commands with --resume-auto.
+
+    One relaunch per checkpoint dir (the newest run wins — older
+    interrupted runs of the same dir are superseded by the resumed one);
+    journal-only HPO runs resume once per experiment. Sequential on
+    purpose: on a freshly recovered TPU VM the device lease is single-
+    owner.
+    """
+    import subprocess
+
+    resumable = [
+        c for c in report
+        if c["effective_status"] == "INTERRUPTED" and c.get("cmdline")
+        and (c.get("resumable_step") is not None
+             or c.get("checkpoint_dir")  # journaled at fit start: a run
+             # killed before its first committed step revives as a
+             # fresh --resume-auto start instead of idling
+             or _journal_has_trials(c["run_dir"]))
+    ]
+    resumable.sort(key=lambda c: c.get("start_time") or 0.0, reverse=True)
+    seen_targets: set[str] = set()
+    rc = 0
+    for cls in resumable:
+        target = cls.get("checkpoint_dir") or f"exp:{cls['experiment']}"
+        if target in seen_targets:
+            continue
+        seen_targets.add(target)
+        argv = _resume_argv(cls["cmdline"])
+        if argv is None:
+            continue
+        print(f"doctor --resume: {cls['experiment']}/{cls['run_id']} -> "
+              + " ".join(argv))
+        # DSST_FAULT_PLAN must not leak into revived runs: cli.main
+        # exports it on every armed invocation, so a doctor running in
+        # a post-chaos environment would otherwise re-arm the very
+        # faults (including kN self-kills) that interrupted the run.
+        env = {k: v for k, v in os.environ.items()
+               if k != "DSST_FAULT_PLAN"}
+        # Relative --data/--checkpoint-dir/--tracking-root in the
+        # recorded argv only mean what they meant from the dying
+        # process's working directory — the journal records it, so the
+        # revival runs there, not wherever the doctor happens to be.
+        cwd = cls.get("cwd")
+        if cwd and not os.path.isdir(cwd):
+            print(f"doctor --resume: recorded cwd {cwd} is gone; "
+                  "skipping " + cls["run_id"])
+            rc = rc or 1
+            continue
+        proc = subprocess.run(
+            [sys.executable, "-m", "dss_ml_at_scale_tpu.config.cli",
+             *argv],
+            env=env,
+            cwd=cwd,
+        )
+        rc = rc or proc.returncode
+    if not resumable:
+        print("doctor --resume: nothing resumable")
+    return rc
+
+
+def _journal_has_trials(run_dir: str) -> bool:
+    from ..tracking import read_journal
+
+    return any(e.get("event") == "trial" for e in read_journal(run_dir))
+
+
+def _resume_argv(cmdline: list[str]) -> list[str] | None:
+    """Recorded dsst argv → relaunch argv: --resume-auto ensured for the
+    resumable subcommands, --fault-plan stripped (a chaos-armed run must
+    not re-arm its own faults on doctor revival)."""
+    argv: list[str] = []
+    skip_next = False
+    for tok in cmdline:
+        if skip_next:
+            skip_next = False
+            continue
+        if tok == "--fault-plan":
+            skip_next = True
+            continue
+        if tok.startswith("--fault-plan="):
+            continue
+        argv.append(tok)
+    subcommands = {"train", "lm", "hpo"}
+    if not any(tok in subcommands for tok in argv):
+        return None
+    if "--resume-auto" not in argv:
+        argv.append("--resume-auto")
+    return argv
+
+
+def register_chaos(sub: argparse._SubParsersAction) -> None:
+    ch = sub.add_parser(
+        "chaos",
+        help="SIGKILL chaos soak: run dsst train/hpo/serve as "
+        "subprocesses, hard-kill them on a seeded schedule (including "
+        "inside the checkpoint-save window via kN fs.* fault entries), "
+        "restart with --resume-auto, and assert the crash-only "
+        "invariants (bitwise final-params parity with an uninterrupted "
+        "run, clean manifest walk, zero stranded .tmp files, every run "
+        "terminal)",
+    )
+    ch.add_argument("--workdir", required=True,
+                    help="scratch directory for data/checkpoints/runs/logs")
+    ch.add_argument("--workload", choices=["train", "hpo", "serve"],
+                    default="train")
+    ch.add_argument("--cycles", type=int, default=5,
+                    help="SIGKILL cycles before the final uninterrupted run")
+    ch.add_argument("--seed", type=int, default=0)
+    ch.add_argument("--kill-min", type=float, default=1.0,
+                    help="delay-mode kill window lower bound (seconds)")
+    ch.add_argument("--kill-max", type=float, default=6.0)
+    ch.add_argument("--epochs", type=int, default=3)
+    ch.add_argument("--rows", type=int, default=48)
+    ch.add_argument("--batch-size", type=int, default=16)
+    ch.add_argument("--image-size", type=int, default=32)
+    ch.add_argument("--max-evals", type=int, default=8,
+                    help="(hpo workload) sweep size")
+    ch.add_argument("--checkpoint-dir", default=None,
+                    help="(serve workload) trained checkpoint to serve")
+    ch.add_argument("--timeout", type=float, default=300.0,
+                    help="per-child wall bound (seconds)")
+    ch.add_argument("--json", action="store_true",
+                    help="emit the full soak report as one JSON document")
+    ch.set_defaults(fn=_cmd_chaos)
+
+
+def _cmd_chaos(args: argparse.Namespace) -> int:
+    from ..resilience.chaos import ChaosConfig, run_chaos
+
+    report = run_chaos(ChaosConfig(
+        workdir=args.workdir,
+        workload=args.workload,
+        cycles=args.cycles,
+        seed=args.seed,
+        kill_min_s=args.kill_min,
+        kill_max_s=args.kill_max,
+        epochs=args.epochs,
+        rows=args.rows,
+        batch_size=args.batch_size,
+        image_size=args.image_size,
+        max_evals=args.max_evals,
+        checkpoint_dir=args.checkpoint_dir,
+        timeout_s=args.timeout,
+    ))
+    if args.json:
+        print(json.dumps(report))
+    else:
+        for c in report.get("cycles", []):
+            print(f"cycle {c.get('cycle')}: mode={c.get('mode')} "
+                  f"rc={c.get('returncode')} wall={c.get('wall_s')}s")
+        for name, res in report["invariants"].items():
+            print(f"invariant {name}: {'OK' if res.get('ok') else 'FAIL'}"
+                  + ("" if res.get("ok") else f" {json.dumps(res)}"))
+        print(f"chaos soak: {'OK' if report['ok'] else 'FAILED'}")
+    return 0 if report["ok"] else 1
 
 
 def register_telemetry(sub: argparse._SubParsersAction) -> None:
@@ -2039,6 +2400,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_checkpoints(sub)
     register_quarantine(sub)
     register_runs(sub)
+    register_chaos(sub)
     register_telemetry(sub)
     register_lint(sub)
     from .pipeline import register_pipeline

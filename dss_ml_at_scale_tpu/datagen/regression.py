@@ -45,16 +45,23 @@ def train_and_eval(data, alpha: float) -> dict:
 
 
 def tune_alpha(objective, parallelism: int = 2, max_evals: int = 4,
-               tracker=None) -> float:
-    """4-eval TPE sweep over alpha on the parallel executor (``:45-56``)."""
+               tracker=None, trials=None) -> float:
+    """4-eval TPE sweep over alpha on the parallel executor (``:45-56``).
+
+    ``trials`` (default: a fresh ``DeviceTrials``) may be a pre-filled
+    store — how ``dsst hpo --resume-auto`` continues a killed sweep from
+    its journaled trials instead of re-running them.
+    """
     from ..hpo import fmin, hp
     from ..parallel import DeviceTrials
 
+    if trials is None:
+        trials = DeviceTrials(parallelism=parallelism)
     best = fmin(
         objective,
         hp.uniform("alpha", 0.0, 10.0),
         max_evals=max_evals,
-        trials=DeviceTrials(parallelism=parallelism),
+        trials=trials,
         rstate=np.random.default_rng(0),
         tracker=tracker,
     )

@@ -145,6 +145,15 @@ def _log_trial(tracker, tid, point, result) -> None:
         metrics["loss"] = result["loss"]
     tracker.log_metrics(metrics, step=tid)
     tracker.log_params({f"trial_{tid}": point})
+    # Intent-log the completed trial (RunStore journals durably): a
+    # killed sweep resumes from exactly the journaled trials
+    # (`dsst hpo --resume-auto`), re-running only what never committed.
+    journal = getattr(tracker, "journal_event", None)
+    if journal is not None:
+        journal(
+            "trial", tid=int(tid), point=dict(point),
+            loss=result.get("loss"), status=result.get("status"),
+        )
 
 
 def fmin(

@@ -54,9 +54,14 @@ def _src_hash() -> str:
 
 
 def _build() -> None:
-    # Compile to a temp path and os.replace into place: atomic for other
-    # processes racing to load the same .so (the in-process lock cannot
-    # cover multi-process launches / pytest-xdist).
+    # Compile to a temp path and durably publish into place: atomic for
+    # other processes racing to load the same .so (the in-process lock
+    # cannot cover multi-process launches / pytest-xdist), and fsynced
+    # so a host dying right after the build can't leave a torn .so that
+    # every later import would dlopen-crash on. Publish order matters:
+    # the hash stamp lands only after the .so it vouches for.
+    from ..resilience.durability import durable_replace, durable_write_text
+
     tmp = _LIB.with_name(f".{_LIB.name}.{os.getpid()}.tmp")
     cmd = [
         "g++", "-O3", "-march=native", "-std=c++17", "-fPIC", "-shared",
@@ -69,8 +74,8 @@ def _build() -> None:
             # Some toolchains lack -march=native; retry plain.
             cmd.remove("-march=native")
             subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp, _LIB)
-        _HASH.write_text(_src_hash())
+        durable_replace(tmp, _LIB, kind="native")
+        durable_write_text(_HASH, _src_hash(), kind="native")
     finally:
         tmp.unlink(missing_ok=True)
 

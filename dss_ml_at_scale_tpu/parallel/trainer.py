@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import telemetry
 from ..data.prefetch import MeshFeeder, split_provenance
 from ..resilience import checkpoint as integrity
+from ..resilience import durability
 from ..resilience import health
 from ..resilience.faults import maybe_fail
 from ..resilience.preemption import PreemptionGuard
@@ -323,6 +324,15 @@ class TrainerConfig:
     best_metric: str | None = None
     best_mode: str | None = None
     resume: bool = False
+    # Crash-only restart entry point (dsst train/lm --resume-auto, the
+    # watchdog's `runs doctor --resume`, and the future arbiter's
+    # revive path): resume from the newest manifest-intact checkpoint
+    # when one exists — falling back past torn steps, quarantining
+    # wreckage, sweeping stranded tmp files — and start FRESH (instead
+    # of erroring) when nothing restorable survives. Unlike `resume`,
+    # it never needs the operator to know whether the previous process
+    # got as far as a checkpoint.
+    resume_auto: bool = False
     # Bound of the background feeder's on-device batch queue (HBM held:
     # feeder_depth batches beyond the in-flight step). ``prefetch_depth``
     # is the legacy name for the same knob; ``feeder_depth`` wins when
@@ -373,6 +383,11 @@ class FitResult:
     # checkpoint rollbacks performed.
     skipped_steps: int = 0
     health_rollbacks: int = 0
+    # True only when resume_auto actually RESTORED a checkpoint — False
+    # when it found nothing, or found only wreckage and fell back to a
+    # fresh start (an operator reading "auto_resumed" must be able to
+    # trust that prior work continued).
+    auto_resumed: bool = False
 
 
 class Trainer:
@@ -522,18 +537,74 @@ class Trainer:
         manager = self._checkpoint_manager(
             cfg, use_best=val_data_factory is not None
         )
-        start_epoch = 0
-        if manager is not None and cfg.resume and manager.latest_step() is not None:
-            state = self._restore(manager, state)
-            manager = self._drop_stale_steps(
-                manager, cfg, int(state.step),
-                use_best=val_data_factory is not None,
+        if manager is not None:
+            # Journal the checkpoint dir BEFORE any training: a run
+            # killed during startup or inside its very first save window
+            # must still be revivable by `runs doctor --resume` (the
+            # committed-step events alone land only after a manifest).
+            self._journal(
+                "config",
+                checkpoint_dir=str(Path(cfg.checkpoint_dir).absolute()),
             )
-            # A preemption checkpoint lands mid-epoch: the resumed first
-            # epoch runs only the REMAINING steps (the step-driven inner
-            # loop below), so the final step count matches an
-            # uninterrupted run exactly.
-            start_epoch = int(state.step) // steps_per_epoch
+        start_epoch = 0
+        auto_resumed = False
+        resume_requested = cfg.resume or cfg.resume_auto
+        if manager is not None and resume_requested and (
+            self.topology.process_index == 0
+        ):
+            # Crash-only hygiene: a hard-killed predecessor may have
+            # stranded durable-write tmps (torn manifest staging) or a
+            # half-written orbax tmp step dir; recovery owns the sweep.
+            # Process 0 only — N processes sweeping one shared
+            # checkpoint FS would race each other (the sweeper's
+            # single-sweeper contract), same discipline as manifest
+            # writes and step quarantine.
+            swept = durability.sweep_stranded_tmp(cfg.checkpoint_dir)
+            if swept:
+                log.warning(
+                    "resume: removed %d stranded tmp artifact(s) under %s",
+                    len(swept), cfg.checkpoint_dir,
+                )
+        if manager is not None and resume_requested and (
+            manager.latest_step() is not None
+        ):
+            try:
+                state = self._restore(manager, state)
+            except FileNotFoundError:
+                if not cfg.resume_auto:
+                    raise
+                # Nothing restorable survived the crash (every step torn
+                # or pre-manifest damage). Crash-only semantics: rename
+                # the wreckage aside and converge to a fresh start —
+                # the same outcome as if no checkpoint had ever landed.
+                log.warning(
+                    "--resume-auto: no intact checkpoint under %s; "
+                    "quarantining remains and starting fresh",
+                    cfg.checkpoint_dir,
+                )
+                manager = self._drop_stale_steps(
+                    manager, cfg, -1,
+                    use_best=val_data_factory is not None,
+                )
+            else:
+                manager = self._drop_stale_steps(
+                    manager, cfg, int(state.step),
+                    use_best=val_data_factory is not None,
+                )
+                # A preemption checkpoint lands mid-epoch: the resumed
+                # first epoch runs only the REMAINING steps (the
+                # step-driven inner loop below), so the final step count
+                # matches an uninterrupted run exactly.
+                start_epoch = int(state.step) // steps_per_epoch
+                if cfg.resume_auto:
+                    auto_resumed = True
+                    telemetry.counter(
+                        "auto_resume_total",
+                        "fits that auto-resumed from a journaled "
+                        "checkpoint without an operator-named step",
+                    ).inc()
+                self._journal("resume", step=int(state.step))
+                self._repair_manifest(cfg, int(state.step))
 
         history: list[dict] = []
         best_value, best_step = self._prior_best(manager, cfg)
@@ -822,6 +893,7 @@ class Trainer:
             health_rollbacks=(
                 supervisor.rollbacks if supervisor is not None else 0
             ),
+            auto_resumed=auto_resumed,
         )
 
     # -- eval -------------------------------------------------------------
@@ -967,6 +1039,15 @@ class Trainer:
                     step_dir = Path(str(manager.directory)) / str(step)
                     if step_dir.is_dir():
                         integrity.write_manifest(step_dir)
+                        # Manifest landed => the step is durably
+                        # committed: record it in the run journal so a
+                        # fresh process (doctor, --resume-auto, the
+                        # arbiter) knows the last committed step without
+                        # walking the checkpoint dir.
+                        self._journal(
+                            "checkpoint", step=step,
+                            checkpoint_dir=str(manager.directory),
+                        )
             except Exception:
                 # A failed manifest leaves the step "unverified" (still
                 # restorable), never a crashed training run.
@@ -989,6 +1070,30 @@ class Trainer:
     def _restore(self, manager, state: TrainState) -> TrainState:
         restored, _ = _restore_with_fallback(manager, _to_pytree(state))
         return TrainState(**restored)
+
+    def _repair_manifest(self, cfg: TrainerConfig, step: int) -> None:
+        """Recovery repairs proof: a restored step with no manifest (its
+        writer was killed inside the save window) just demonstrated its
+        bytes load — hash them NOW so the step verifies "intact" from
+        here on instead of staying "unverified" forever. Journaled as
+        ``manifest_repair`` (not ``checkpoint``: nothing new was
+        committed)."""
+        if self.topology.process_index != 0:
+            return
+        step_dir = Path(cfg.checkpoint_dir) / str(step)
+        if not step_dir.is_dir() or (
+            step_dir / integrity.MANIFEST_NAME
+        ).exists():
+            return
+        try:
+            integrity.write_manifest(step_dir)
+        except Exception:
+            log.exception("manifest repair failed for step %d", step)
+            return
+        self._journal(
+            "manifest_repair", step=step,
+            checkpoint_dir=str(Path(cfg.checkpoint_dir).absolute()),
+        )
 
     def _drop_stale_steps(self, manager, cfg: TrainerConfig,
                           restored_step: int, *, use_best: bool):
@@ -1076,6 +1181,19 @@ class Trainer:
     def _log(self, metrics: dict, step: int) -> None:
         if self.tracker is not None:
             self.tracker.log_metrics(metrics, step)
+
+    def _journal(self, event: str, **fields) -> None:
+        """Append to the tracker's run journal, if the tracker keeps one
+        (RunStore does; foreign trackers may not — duck-typed so the
+        Trainer stays tracker-agnostic)."""
+        if event == "checkpoint":
+            hook = getattr(self.tracker, "journal_checkpoint", None)
+            if hook is not None:
+                hook(fields["step"], fields["checkpoint_dir"])
+            return
+        hook = getattr(self.tracker, "journal_event", None)
+        if hook is not None:
+            hook(event, **fields)
 
 
 def _zero1_shardings(opt_state, mesh: Mesh, axis: str):

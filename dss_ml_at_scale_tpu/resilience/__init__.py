@@ -30,6 +30,19 @@ equivalent for the seams Spark used to cover:
   :class:`~.rollback.RowRange` provenance and the JSONL
   :class:`~.rollback.QuarantineList` blocklist the reader consults on
   replay/resume (``dsst quarantine list|clear``).
+- :mod:`.durability` — crash-only publishes: write tmp → fsync →
+  atomic rename → fsync parent dir, with ``fs.*`` fault sites that tear
+  each stage exactly like a power cut. Adopted at every publish point
+  (checkpoint manifests, run-store JSON, quarantine/journal appends,
+  health bundles, the native-lib build) and enforced package-wide by
+  the ``durable-write`` lint rule.
+- :mod:`.chaos` — the SIGKILL soak supervisor behind ``dsst chaos``:
+  runs ``dsst train``/``hpo``/``serve`` as subprocesses, kills them on
+  a seeded schedule (including inside the checkpoint-save window via
+  ``kN`` fs.* fault entries), restarts with ``--resume-auto``, and
+  asserts convergence invariants (bitwise final-params parity with an
+  uninterrupted run, clean manifest walk, zero stranded tmps, every
+  run terminal).
 
 Recovery events meter themselves on the process telemetry registry:
 ``retry_total{site=}``, ``worker_readmitted_total``,
@@ -41,6 +54,7 @@ Recovery events meter themselves on the process telemetry registry:
 from __future__ import annotations
 
 from .checkpoint import MANIFEST_NAME, verify_checkpoint_dir, verify_step, write_manifest  # noqa: F401
+from .durability import append_jsonl, durable_replace, durable_write_bytes, durable_write_json, durable_write_text, fsync_dir, sweep_stranded_tmp  # noqa: F401
 from .faults import KNOWN_SITES, FaultPlan, InjectedFault, active_plan, clear, fault_fires, install, install_from_spec, maybe_fail  # noqa: F401
 from .preemption import PreemptionGuard  # noqa: F401
 from .retry import RetryPolicy, call_with_retry, is_transient  # noqa: F401
@@ -59,13 +73,20 @@ __all__ = [
     "RowRange",
     "WorkerPool",
     "active_plan",
+    "append_jsonl",
     "call_with_retry",
     "clear",
+    "durable_replace",
+    "durable_write_bytes",
+    "durable_write_json",
+    "durable_write_text",
     "fault_fires",
+    "fsync_dir",
     "install",
     "install_from_spec",
     "is_transient",
     "maybe_fail",
+    "sweep_stranded_tmp",
     "verify_checkpoint_dir",
     "verify_step",
     "write_manifest",

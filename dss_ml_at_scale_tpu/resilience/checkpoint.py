@@ -23,10 +23,10 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
-import os
 from pathlib import Path
 
 from .. import telemetry
+from . import durability
 
 log = logging.getLogger(__name__)
 
@@ -56,12 +56,15 @@ def write_manifest(step_dir: str | Path) -> dict:
                 "bytes": p.stat().st_size,
             }
     manifest = {"version": 1, "files": files}
-    # Atomic publish: a crash mid-write must leave NO manifest (the step
-    # stays "unverified" and restorable), never a truncated one (which
-    # would read as "corrupt" and roll an intact step back).
-    tmp = step_dir / (MANIFEST_NAME + ".tmp")
-    tmp.write_text(json.dumps(manifest))
-    os.replace(tmp, step_dir / MANIFEST_NAME)
+    # Durable atomic publish (tmp → fsync → rename → fsync dir): a crash
+    # mid-write must leave NO manifest (the step stays "unverified" and
+    # restorable), never a truncated one (which would read as "corrupt"
+    # and roll an intact step back) — and once published, the manifest
+    # must survive a power cut, or the step it vouches for would lose
+    # its proof on the next boot.
+    durability.durable_write_json(
+        step_dir / MANIFEST_NAME, manifest, kind="manifest"
+    )
     return manifest
 
 
@@ -137,7 +140,7 @@ def quarantine_step(step_dir: str | Path) -> Path | None:
         n += 1
         target = step_dir.with_name(f"{step_dir.name}.corrupt-{n}")
     try:
-        step_dir.rename(target)
+        step_dir.rename(target)  # dsst: ignore[durable-write] idempotent move-aside: a crash that loses it re-detects the corrupt step and re-quarantines on next resume
     except OSError as e:
         log.warning("could not quarantine %s: %s", step_dir, e)
         return None

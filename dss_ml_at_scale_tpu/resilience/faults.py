@@ -15,10 +15,16 @@ Plan spec grammar (semicolon-separated entries)::
     grads.nonfinite=1@5          # skip the first 5 hits, fail the next 1
     reader.next=p0.25            # fail each hit with probability 0.25
     checkpoint.restore=1;seed=7  # seed the probability draws
+    fs.crash_after_tmp=k1        # SIGKILL the process at the 1st hit
 
 ``N@K`` targets a specific occurrence — "poison exactly training step
 K" — which is how the health-supervisor chaos tests make a fault land
-on a chosen batch deterministically.
+on a chosen batch deterministically. ``kN``/``kN@K`` is the power-cut
+twin of ``N``: instead of raising, the firing hit delivers SIGKILL to
+the *current process* — the only way to place a hard kill exactly
+inside a write window (e.g. between a checkpoint manifest's staged tmp
+and its atomic rename), which is what the ``dsst chaos`` soak uses to
+prove the durability layer converges after real mid-publish deaths.
 
 Site names are dotted paths; a spec entry matches a checked site when it
 is equal to it or a dotted prefix of it (``rpc.send`` arms
@@ -41,6 +47,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import random
 import threading
 import zlib
@@ -66,6 +73,16 @@ KNOWN_SITES = {
                        "step's loss/grad-norm health signals)",
     "loss.spike": "a loss spike far outside the EWMA band on one "
                   "train step",
+    "fs.torn_write": "a power cut mid-write: the durable writer leaves "
+                     "a truncated .tmp and fails before publish (suffix "
+                     ".<kind>: manifest, run_json, journal, quarantine, "
+                     "bundle, native)",
+    "fs.crash_after_tmp": "a crash between the staged .tmp write and "
+                          "its atomic rename: a complete .tmp is left, "
+                          "nothing published (suffix .<kind> as "
+                          "fs.torn_write; arm kN to SIGKILL in-window)",
+    "fs.fsync": "an fsync raising (EIO-like) during a durable publish "
+                "(suffix .<kind> as fs.torn_write)",
 }
 
 
@@ -80,6 +97,7 @@ class _Site:
     count: int | None = None      # exact-count mode: fail the next N hits
     probability: float = 0.0      # probability mode: seeded per-hit draw
     skip: int = 0                 # N@K mode: hits to pass before firing
+    kill: bool = False            # kN mode: SIGKILL the process on fire
     hits: int = 0                 # matching check()/fires() calls observed
     fired: int = 0                # faults actually raised
 
@@ -120,14 +138,15 @@ class FaultPlan:
                     )
                 sites[name] = _Site(probability=p)
             else:
-                count_s, at, skip_s = value.partition("@")
+                kill = value.startswith("k")
+                count_s, at, skip_s = value[1 if kill else 0:].partition("@")
                 n = int(count_s)
                 skip = int(skip_s) if at else 0
                 if n < 0 or skip < 0:
                     raise ValueError(
                         f"fault count/offset must be >= 0, got {entry!r}"
                     )
-                sites[name] = _Site(count=n, skip=skip)
+                sites[name] = _Site(count=n, skip=skip, kill=kill)
         plan = cls(sites, seed=seed)
         return plan
 
@@ -141,12 +160,17 @@ class FaultPlan:
             probe, _, _ = probe.rpartition(".")
         return None
 
-    def _consume(self, site: str) -> bool:
-        """Advance the matching entry's state for one hit; True = fire."""
+    def _consume(self, site: str) -> tuple[bool, bool]:
+        """Advance the matching entry's state for one hit.
+
+        Returns ``(fire, kill)``: ``fire`` when the plan arms this hit,
+        ``kill`` when the armed entry is a ``kN`` power-cut entry (the
+        caller delivers SIGKILL to the process instead of raising).
+        """
         with self._lock:
             hit = self._match(site)
             if hit is None:
-                return False
+                return False, False
             name, armed = hit
             armed.hits += 1
             fire = False
@@ -176,11 +200,14 @@ class FaultPlan:
                 "faults_injected_total", "faults raised by the active "
                 "FaultPlan", labels=("site",),
             ).labels(site=name).inc()
-        return fire
+        return fire, armed.kill
 
     def check(self, site: str) -> None:
         """Raise :class:`InjectedFault` if the plan arms this hit."""
-        if self._consume(site):
+        fire, kill = self._consume(site)
+        if fire:
+            if kill:
+                _sigkill_self(site)
             log.warning("fault plan: injecting fault at site %r", site)
             raise InjectedFault(f"injected fault at site {site!r}")
 
@@ -192,7 +219,10 @@ class FaultPlan:
         gradients, corrupt sample bytes): the caller applies its own
         corruption when this returns True.
         """
-        if self._consume(site):
+        fire, kill = self._consume(site)
+        if fire:
+            if kill:
+                _sigkill_self(site)
             log.warning("fault plan: arming value fault at site %r", site)
             return True
         return False
@@ -204,6 +234,20 @@ class FaultPlan:
                 name: {"hits": s.hits, "fired": s.fired}
                 for name, s in self._sites.items()
             }
+
+
+def _sigkill_self(site: str) -> None:
+    """The power-cut: SIGKILL the current process at the armed site.
+
+    Flushes nothing on purpose — a real power cut doesn't either. The
+    log line goes to stderr (unbuffered enough in practice to usually
+    survive), then the uncatchable kill lands; no Python cleanup, no
+    atexit, no finally blocks run.
+    """
+    import signal
+
+    log.warning("fault plan: SIGKILL (power cut) at site %r", site)
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 # -- process-global plan -----------------------------------------------------

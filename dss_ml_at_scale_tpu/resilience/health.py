@@ -54,6 +54,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from .. import telemetry
+from . import durability
 from .faults import active_plan, fault_fires
 
 log = logging.getLogger(__name__)
@@ -345,8 +346,12 @@ class HealthSupervisor:
                 # DEFINITION non-finite floats, which json.dumps would
                 # emit as bare `NaN` tokens — invalid JSON for the strict
                 # parsers (jq, JSON.parse) an operator points at a 3am
-                # abort.
-                path.write_text(json.dumps(_json_safe(bundle), indent=1))
+                # abort. Durable publish: the bundle is the run's last
+                # word — it must survive the process (and the host)
+                # dying right after.
+                durability.durable_write_json(
+                    path, _json_safe(bundle), indent=1, kind="bundle"
+                )
                 bundle_path = str(path)
             except OSError:
                 log.exception("could not write health diagnostic bundle")

@@ -37,6 +37,8 @@ from typing import Iterable, Sequence
 
 import numpy as np
 
+from . import durability
+
 log = logging.getLogger(__name__)
 
 # Side-channel batch key the reader attaches provenance under; consumers
@@ -148,7 +150,6 @@ class QuarantineList:
         a replay/resume is invoked from a different cwd or with a
         different spelling of the dataset path.
         """
-        lines = []
         new_entries = []
         for r in ranges:
             obj = r.to_json()
@@ -157,20 +158,24 @@ class QuarantineList:
             if step is not None:
                 obj["step"] = int(step)
             obj["time"] = time.time()
-            lines.append(json.dumps(obj))
             new_entries.append(obj)
-        if not lines:
+        if not new_entries:
             return 0
         self.path.parent.mkdir(parents=True, exist_ok=True)
         with self._lock:
-            with self.path.open("a") as f:
-                f.write("\n".join(lines) + "\n")
+            # Durable append (fsynced): "these rows are excluded from
+            # replay/resume" is a promise to FUTURE processes — a
+            # power cut right after the discard must not let the poison
+            # rows back in.
+            durability.append_jsonl(
+                self.path, new_entries, kind="quarantine"
+            )
             self._entries.extend(new_entries)
             for obj in new_entries:
                 self._index.setdefault(
                     (_norm_path(obj["path"]), int(obj["row_group"])), []
                 ).append((int(obj["row_lo"]), int(obj["row_hi"])))
-        return len(lines)
+        return len(new_entries)
 
     def clear(self) -> int:
         """Remove every entry (and the file); returns how many were held."""

@@ -19,14 +19,17 @@ from __future__ import annotations
 # name -> kind ("counter" | "gauge" | "histogram")
 KNOWN_METRICS: dict[str, str] = {
     # -- checkpointing / resilience ---------------------------------------
+    "auto_resume_total": "counter",
     "checkpoint_fallback_total": "counter",
     "faults_injected_total": "counter",
+    "fsync_seconds_total": "counter",
     "health_rollbacks_total": "counter",
     "loss_spikes_total": "counter",
     "nonfinite_steps_total": "counter",
     "preemption_signals_total": "counter",
     "quarantined_batches_total": "counter",
     "retry_total": "counter",
+    "runs_interrupted_total": "counter",
     "worker_readmitted_total": "counter",
     # -- device / compile --------------------------------------------------
     "device_hbm_bytes_in_use": "gauge",

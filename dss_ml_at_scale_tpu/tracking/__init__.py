@@ -1,3 +1,13 @@
-"""Experiment tracking: run/param/metric/artifact store."""
+"""Experiment tracking: run/param/metric/artifact store + run journal."""
 
-from .store import RunStore, list_runs, load_run, start_run  # noqa: F401
+from .store import (  # noqa: F401
+    JOURNAL_NAME,
+    RunStore,
+    classify_run,
+    list_runs,
+    load_run,
+    read_journal,
+    set_run_cmdline,
+    start_run,
+    sweep_interrupted,
+)

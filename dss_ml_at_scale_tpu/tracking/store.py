@@ -19,6 +19,21 @@ are already globally-reduced inside SPMD programs, so **only process 0
 writes**; non-coordinator processes get a no-op store. An optional
 ``to_mlflow`` export bridges to a real MLflow server when the client
 library is installed.
+
+**Crash-only discipline** (the gap the original design left open:
+``finish()`` never runs on a hard kill, so killed runs sat RUNNING
+forever): every ``*.json`` publish is durable-atomic
+(``resilience.durability``), and each run keeps an intent log —
+``journal.jsonl`` — recording the writer's PID + boot id, the invoking
+command line, every committed checkpoint step, and the terminal status.
+A fresh process can therefore classify any run on disk
+(:func:`classify_run`): FINISHED / FAILED / INTERRUPTED (meta says
+RUNNING but the recorded PID is dead or from another boot) / RUNNING
+(PID alive, same boot). ``dsst runs doctor``
+(:func:`sweep_interrupted`) sweeps a store root, durably marks dead
+runs INTERRUPTED, clears stranded tmp files, and reports which runs
+have a resumable checkpoint — the entry point a watchdog or arbiter
+uses to converge the store after any number of kills.
 """
 
 from __future__ import annotations
@@ -27,6 +42,7 @@ import contextlib
 import json
 import os
 import shutil
+import threading
 import time
 import uuid
 from pathlib import Path
@@ -34,9 +50,54 @@ from typing import Any, Mapping
 
 import jax
 
+from ..resilience import durability
+
+JOURNAL_NAME = "journal.jsonl"
+TERMINAL_STATUSES = ("FINISHED", "FAILED", "INTERRUPTED")
+
+# Journal heartbeat throttle: log_metrics touches the journal's mtime at
+# most this often, so "heartbeat age" stays meaningful without an fsync
+# per metric line.
+_HEARTBEAT_EVERY_S = 5.0
+
+# The dsst argv of the current invocation, stashed by the CLI so the
+# journal's start event records a replayable command line (what
+# `dsst runs doctor --resume` re-executes with --resume-auto).
+_run_cmdline: list[str] | None = None
+
+
+def set_run_cmdline(argv: list[str] | None) -> None:
+    global _run_cmdline
+    _run_cmdline = list(argv) if argv is not None else None
+
 
 def _now() -> float:
     return time.time()
+
+
+def boot_id() -> str:
+    """Kernel boot identity, so a recycled PID on a rebooted host can
+    never masquerade as a live run."""
+    try:
+        return Path(
+            "/proc/sys/kernel/random/boot_id"
+        ).read_text().strip()
+    except OSError:
+        return ""
+
+
+def pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return False
+    return True
 
 
 class RunStore:
@@ -66,6 +127,19 @@ class RunStore:
                 "run_name": run_name or self.run_id, "status": "RUNNING",
                 "start_time": _now()}
         self._write_json("meta.json", meta)
+        # Intent log: who is writing this run, from which boot, launched
+        # how. The journal is what lets a FUTURE process classify this
+        # run after a hard kill — meta.json alone can only ever say
+        # RUNNING.
+        self._journal_lock = threading.Lock()
+        self._last_heartbeat = 0.0
+        start_event: dict[str, Any] = {
+            "event": "start", "pid": os.getpid(), "boot_id": boot_id(),
+            "cwd": os.getcwd(),
+        }
+        if _run_cmdline is not None:
+            start_event["cmdline"] = list(_run_cmdline)
+        self.journal_event(**start_event)
 
     # -- logging ----------------------------------------------------------
 
@@ -89,6 +163,46 @@ class RunStore:
                 + "\n"
             )
         self._metrics.flush()
+        self._heartbeat(ts)
+
+    def _heartbeat(self, ts: float) -> None:
+        """Throttled journal mtime touch: liveness evidence for the
+        doctor without an fsync per metric line."""
+        if ts - self._last_heartbeat < _HEARTBEAT_EVERY_S:
+            return
+        self._last_heartbeat = ts
+        try:
+            os.utime(self.path / JOURNAL_NAME)
+        except OSError:
+            pass
+
+    # -- the run journal (intent log) -------------------------------------
+
+    def journal_event(self, event: str, **fields: Any) -> None:
+        """Durably append one intent-log line (``journal.jsonl``).
+
+        Events the package writes: ``start`` (pid/boot_id/cmdline),
+        ``resume`` (restored checkpoint step), ``checkpoint``
+        (manifest-committed step + dir), ``trial`` (completed HPO
+        trial), ``finish`` (terminal status), ``interrupted`` (doctor
+        verdict). Foreign events are fine — readers ignore what they
+        don't know.
+        """
+        if not self.active:
+            return
+        obj = {"event": event, "time": _now(), **fields}
+        with self._journal_lock:
+            durability.append_jsonl(
+                self.path / JOURNAL_NAME, [obj], kind="journal"
+            )
+
+    def journal_checkpoint(self, step: int, checkpoint_dir: str) -> None:
+        """Record a manifest-committed checkpoint step — the journal's
+        'last committed step' the doctor reports as resumable."""
+        self.journal_event(
+            "checkpoint", step=int(step),
+            checkpoint_dir=str(Path(checkpoint_dir).absolute()),
+        )
 
     def log_artifact(self, src: str | os.PathLike, name: str | None = None) -> None:
         if not self.active:
@@ -123,6 +237,7 @@ class RunStore:
         if not self.active or self._closed:
             return
         self._closed = True
+        self.journal_event("finish", status=status)
         meta = json.loads((self.path / "meta.json").read_text())
         meta.update(status=status, end_time=_now())
         self._write_json("meta.json", meta)
@@ -155,9 +270,12 @@ class RunStore:
         return json.loads(f.read_text()) if self.active and f.exists() else {}
 
     def _write_json(self, name: str, obj) -> None:
-        tmp = self.path / (name + ".tmp")
-        tmp.write_text(json.dumps(obj, indent=2))
-        tmp.replace(self.path / name)
+        # Durable atomic publish: meta.json flipping to FINISHED (or a
+        # params/telemetry rewrite) must survive a power cut and can
+        # never be read torn.
+        durability.durable_write_json(
+            self.path / name, obj, indent=2, kind="run_json"
+        )
 
     # -- optional MLflow bridge ------------------------------------------
 
@@ -175,6 +293,184 @@ class RunStore:
             mlflow.log_params(self.params())
             for m in self.metrics():
                 mlflow.log_metric(m["name"], m["value"], step=m["step"] or 0)
+
+
+def read_journal(run_dir: str | os.PathLike) -> list[dict]:
+    """Parse a run's ``journal.jsonl``, tolerating a torn last line
+    (a kill mid-append is exactly the condition the journal exists
+    for)."""
+    path = Path(run_dir) / JOURNAL_NAME
+    events: list[dict] = []
+    if not path.exists():
+        return events
+    try:
+        text = path.read_text(encoding="utf-8")
+    except OSError:
+        return events
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn append: skip, never crash the classifier
+        if isinstance(obj, dict) and "event" in obj:
+            events.append(obj)
+    return events
+
+
+def classify_run(run_dir: str | os.PathLike) -> dict:
+    """Journal-based status of one run directory, judged from disk.
+
+    Returns a dict with (at least): ``status`` (the stored meta
+    status), ``effective_status`` (FINISHED / FAILED / INTERRUPTED /
+    RUNNING / UNKNOWN), ``live`` (pid alive, same boot), ``pid``,
+    ``last_step`` + ``checkpoint_dir`` (newest journaled checkpoint
+    commit), ``heartbeat_age_s``, and ``cmdline`` (the recorded dsst
+    invocation, for doctor --resume).
+    """
+    run_dir = Path(run_dir)
+    out: dict[str, Any] = {
+        "run_dir": str(run_dir),
+        "run_id": run_dir.name,
+        "experiment": run_dir.parent.name,
+        "status": None,
+        "effective_status": "UNKNOWN",
+        "live": False,
+        "pid": None,
+        "last_step": None,
+        "checkpoint_dir": None,
+        "cmdline": None,
+        "cwd": None,
+        "heartbeat_age_s": None,
+    }
+    try:
+        meta = json.loads((run_dir / "meta.json").read_text())
+    except (OSError, json.JSONDecodeError):
+        return out
+    out["status"] = meta.get("status")
+    out["start_time"] = meta.get("start_time")
+    events = read_journal(run_dir)
+    for e in events:
+        if e["event"] == "start":
+            out["pid"] = e.get("pid")
+            out["boot_id"] = e.get("boot_id", "")
+            if e.get("cmdline"):
+                out["cmdline"] = e["cmdline"]
+            if e.get("cwd"):
+                out["cwd"] = e["cwd"]
+        elif e["event"] == "config":
+            if e.get("checkpoint_dir"):
+                out["checkpoint_dir"] = e["checkpoint_dir"]
+        elif e["event"] in ("checkpoint", "manifest_repair"):
+            out["last_step"] = e.get("step")
+            out["checkpoint_dir"] = e.get("checkpoint_dir")
+    journal = run_dir / JOURNAL_NAME
+    try:
+        out["heartbeat_age_s"] = round(_now() - journal.stat().st_mtime, 1)
+    except OSError:
+        pass
+    if out["status"] in TERMINAL_STATUSES:
+        out["effective_status"] = out["status"]
+        return out
+    if out["status"] != "RUNNING":
+        return out
+    if out["pid"] is None:
+        # A pre-journal (or torn-at-birth) RUNNING run: nothing can
+        # vouch for a live writer, so it is interrupted by default.
+        out["effective_status"] = "INTERRUPTED"
+        return out
+    same_boot = (not out.get("boot_id")) or out["boot_id"] == boot_id()
+    out["live"] = same_boot and pid_alive(int(out["pid"]))
+    out["effective_status"] = "RUNNING" if out["live"] else "INTERRUPTED"
+    return out
+
+
+def sweep_interrupted(root, experiment: str | None = None, *,
+                      mark: bool = True) -> list[dict]:
+    """The ``dsst runs doctor`` core: classify every run under ``root``.
+
+    Dead-PID RUNNING runs are (with ``mark=True``) durably flipped to
+    INTERRUPTED in ``meta.json``, journaled (``interrupted`` event),
+    counted on ``runs_interrupted_total``, and swept of stranded
+    ``*.tmp`` files. Each returned entry additionally carries
+    ``resumable_step``: the newest manifest-intact (or unverified)
+    checkpoint step under the run's journaled checkpoint dir, or None.
+    """
+    from .. import telemetry
+    from ..resilience import checkpoint as integrity
+
+    interrupted = telemetry.counter(
+        "runs_interrupted_total",
+        "dead-PID RUNNING runs marked INTERRUPTED by the doctor sweep",
+    )
+    root = Path(root)
+    report: list[dict] = []
+    experiments = (
+        [root / experiment] if experiment
+        else sorted(p for p in root.iterdir() if p.is_dir())
+        if root.is_dir() else []
+    )
+    for exp_dir in experiments:
+        if not exp_dir.is_dir():
+            continue
+        for run_dir in sorted(p for p in exp_dir.iterdir() if p.is_dir()):
+            cls = classify_run(run_dir)
+            if cls["status"] is None:
+                continue  # foreign/unreadable directory: not a run
+            newly_marked = (
+                mark
+                and cls["status"] == "RUNNING"
+                and cls["effective_status"] == "INTERRUPTED"
+            )
+            if newly_marked:
+                try:
+                    meta = json.loads((run_dir / "meta.json").read_text())
+                    meta.update(
+                        status="INTERRUPTED",
+                        end_time=(run_dir / JOURNAL_NAME).stat().st_mtime
+                        if (run_dir / JOURNAL_NAME).exists() else _now(),
+                        interrupted_by="runs doctor",
+                    )
+                    durability.durable_write_json(
+                        run_dir / "meta.json", meta, indent=2,
+                        kind="run_json",
+                    )
+                    durability.append_jsonl(
+                        run_dir / JOURNAL_NAME,
+                        [{"event": "interrupted", "time": _now(),
+                          "by": "runs doctor",
+                          "dead_pid": cls["pid"]}],
+                        kind="journal",
+                    )
+                except OSError as e:
+                    # The mark did NOT land: report and count nothing —
+                    # a "marked" claim the next sweep repeats would
+                    # double-count forever and lie to the operator.
+                    cls["mark_error"] = str(e)
+                else:
+                    interrupted.inc()
+                    cls["marked"] = True
+                    swept = durability.sweep_stranded_tmp(run_dir)
+                    cls["swept_tmp"] = [str(p) for p in swept]
+            cls["resumable_step"] = None
+            if (
+                cls["effective_status"] == "INTERRUPTED"
+                and cls["checkpoint_dir"]
+                and Path(cls["checkpoint_dir"]).is_dir()
+            ):
+                for step in sorted(
+                    integrity.list_steps(cls["checkpoint_dir"]), reverse=True
+                ):
+                    status, _ = integrity.verify_step(
+                        Path(cls["checkpoint_dir"]) / str(step)
+                    )
+                    if status in ("intact", "unverified"):
+                        cls["resumable_step"] = step
+                        break
+            report.append(cls)
+    return report
 
 
 def list_runs(root, experiment: str | None = None) -> list[dict]:
@@ -206,6 +502,15 @@ def list_runs(root, experiment: str | None = None) -> list[dict]:
                 meta["wall_seconds"] = round(
                     meta["end_time"] - meta["start_time"], 1
                 )
+            if meta.get("status") == "RUNNING":
+                # Journal-truth rendering: a RUNNING run whose recorded
+                # PID is dead shows as INTERRUPTED in listings even
+                # before a doctor sweep rewrites its meta (the listing
+                # itself never writes).
+                cls = classify_run(run_dir)
+                meta["live"] = cls["live"]
+                if cls["effective_status"] == "INTERRUPTED":
+                    meta["status"] = "INTERRUPTED"
             out.append(meta)
     out.sort(key=lambda m: m.get("start_time", 0.0), reverse=True)
     return out

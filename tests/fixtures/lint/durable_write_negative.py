@@ -1,0 +1,36 @@
+"""durable-write negative fixture: idioms the rule must spare."""
+
+import dataclasses
+import os
+
+from dss_ml_at_scale_tpu.resilience import durability
+
+
+def through_the_layer(path, payload: bytes):
+    # The sanctioned publish path.
+    durability.durable_write_bytes(path, payload, kind="run_json")
+
+
+def staged_by_external_writer(tmp, dst):
+    durability.durable_replace(tmp, dst, kind="native")
+
+
+def string_rewrite(s: str) -> str:
+    return s.replace("{workdir}", "/tmp")  # str.replace: two args
+
+
+def config_copy(cfg):
+    return dataclasses.replace(cfg, resume=True)  # kwargs, not a rename
+
+
+def struct_copy(state, opt):
+    return state.replace(opt_state=opt)  # flax struct .replace(**kw)
+
+
+def frame_relabel(df, mapping):
+    return df.rename(columns=mapping)  # pandas .rename(**kw), no publish
+
+
+def reasoned_exception(tmp, dst):
+    # A same-directory scratch swap that no reader ever observes.
+    os.replace(tmp, dst)  # dsst: ignore[durable-write] scratch swap inside one private tempdir, never a published name

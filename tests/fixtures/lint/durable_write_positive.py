@@ -1,0 +1,31 @@
+"""durable-write positive fixture: 6 findings expected."""
+
+import os
+from os import replace as os_replace
+from pathlib import Path
+
+
+def publish_manifest(tmp, dst):
+    os.replace(tmp, dst)  # finding: bare os.replace publish
+
+
+def publish_meta(path):
+    tmp = Path(str(path) + ".tmp")
+    tmp.write_text("{}")
+    tmp.replace(path)  # finding: Path.replace(target) publish
+
+
+def publish_lib(staged: Path, lib: Path):
+    staged.replace(lib)  # finding: Path.replace(target) publish
+
+
+def publish_via_rename(tmp, dst):
+    os.rename(tmp, dst)  # finding: same syscall, rename spelling
+
+
+def publish_via_path_rename(tmp: Path, dst: Path):
+    tmp.rename(dst)  # finding: Path.rename(target) publish
+
+
+def publish_via_bare_import(tmp, dst):
+    os_replace(tmp, dst)  # finding: from-os import alias publish

@@ -1,0 +1,526 @@
+"""Crash-only runtime (PR 7): durable publishes, run journal,
+auto-resume, runs doctor, and the SIGKILL chaos soak.
+
+Layers:
+
+- durability unit tests: the publish sequence and its fs.* fault sites
+  (torn tmp, crash-after-tmp, fsync failure, torn-append healing);
+- journal/classification: RunStore intent log → FINISHED / RUNNING /
+  INTERRUPTED (dead PID) verdicts, `dsst runs doctor` marking + listing;
+- Trainer --resume-auto: step parity with explicit --resume, fresh
+  start on an empty dir, fallback past a torn (save-window-killed) step
+  with manifest repair;
+- dsst hpo --resume-auto: journaled trials continue a killed sweep;
+- the acceptance soak: a seeded `dsst chaos` run — 5 SIGKILL cycles
+  against `dsst train`, one forced inside the checkpoint-save window
+  via a kN fs.* fault entry — converges with final params bitwise-equal
+  to an uninterrupted same-seed run (tier-1 short config here; the
+  minute-long soak + hpo/serve cycles ride `-m slow`).
+"""
+
+import json
+import os
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from dss_ml_at_scale_tpu import telemetry
+from dss_ml_at_scale_tpu.resilience import (
+    FaultPlan,
+    InjectedFault,
+    MANIFEST_NAME,
+    durability,
+    faults,
+    verify_step,
+)
+from dss_ml_at_scale_tpu.tracking import (
+    JOURNAL_NAME,
+    RunStore,
+    classify_run,
+    list_runs,
+    read_journal,
+    set_run_cmdline,
+    sweep_interrupted,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+    set_run_cmdline(None)
+
+
+def _counter(name, **labels):
+    for m in telemetry.snapshot()["metrics"]:
+        if m["name"] == name and (m.get("labels") or {}) == labels:
+            return m["value"]
+    return 0.0
+
+
+def _dead_pid() -> int:
+    p = subprocess.Popen(["sleep", "0"])
+    p.wait()
+    return p.pid
+
+
+# -- durability ---------------------------------------------------------------
+
+
+def test_durable_write_publishes_atomically_and_meters_fsync(tmp_path):
+    before = _counter("fsync_seconds_total")
+    p = durability.durable_write_json(tmp_path / "m.json", {"a": 1},
+                                      kind="run_json")
+    assert json.loads(p.read_text()) == {"a": 1}
+    assert not (tmp_path / "m.json.tmp").exists()
+    assert _counter("fsync_seconds_total") > before  # file + dir fsyncs
+
+
+def test_torn_write_strands_truncated_tmp_and_keeps_target(tmp_path):
+    p = tmp_path / "m.json"
+    durability.durable_write_json(p, {"a": 1}, kind="run_json")
+    faults.install(FaultPlan.parse("fs.torn_write.run_json=1"))
+    with pytest.raises(InjectedFault):
+        durability.durable_write_json(p, {"a": 2}, kind="run_json")
+    assert json.loads(p.read_text()) == {"a": 1}  # old target intact
+    tmp = tmp_path / "m.json.tmp"
+    assert tmp.exists()
+    assert len(tmp.read_bytes()) < len(json.dumps({"a": 2}))  # torn
+
+
+def test_crash_after_tmp_leaves_complete_tmp_unpublished(tmp_path):
+    p = tmp_path / "m.json"
+    faults.install(FaultPlan.parse("fs.crash_after_tmp=1"))
+    with pytest.raises(InjectedFault):
+        durability.durable_write_json(p, {"a": 3}, kind="run_json")
+    assert not p.exists()
+    assert json.loads((tmp_path / "m.json.tmp").read_text()) == {"a": 3}
+
+
+def test_fsync_fault_surfaces(tmp_path):
+    faults.install(FaultPlan.parse("fs.fsync=1"))
+    with pytest.raises(InjectedFault):
+        durability.durable_write_json(tmp_path / "m.json", {}, kind="x")
+
+
+def test_sweep_stranded_tmp_spares_quarantine_forensics(tmp_path):
+    (tmp_path / "a.tmp").write_text("")
+    corrupt = tmp_path / "6.corrupt"
+    corrupt.mkdir()
+    (corrupt / "b.tmp").write_text("")
+    removed = durability.sweep_stranded_tmp(tmp_path)
+    assert [p.name for p in removed] == ["a.tmp"]
+    assert (corrupt / "b.tmp").exists()
+
+
+def test_append_jsonl_heals_torn_tail(tmp_path):
+    p = tmp_path / "j.jsonl"
+    durability.append_jsonl(p, [{"event": "start"}])
+    with open(p, "a") as f:
+        f.write('{"torn')  # killed mid-append: no newline
+    durability.append_jsonl(p, [{"event": "finish"}])
+    events = [json.loads(l) for l in p.read_text().splitlines()
+              if l.strip() and not l.startswith('{"torn')]
+    assert [e["event"] for e in events] == ["start", "finish"]
+
+
+def test_kill_mode_grammar_parses():
+    plan = FaultPlan.parse("fs.crash_after_tmp.manifest=k1@2;seed=3")
+    stats = plan.stats()
+    assert "fs.crash_after_tmp.manifest" in stats
+    for bad in ("x=k", "x=k-1", "x=kp1"):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+
+# -- journal + classification + doctor ---------------------------------------
+
+
+def test_journal_classifies_live_finished_and_interrupted(tmp_path):
+    set_run_cmdline(["train", "--data", "t"])
+    run = RunStore(tmp_path, "exp", run_name="r")
+    run.log_metrics({"loss": 1.0}, step=1)
+    run.journal_checkpoint(3, str(tmp_path / "ckpt"))
+    cls = classify_run(run.path)
+    assert cls["effective_status"] == "RUNNING" and cls["live"]
+    assert cls["last_step"] == 3
+    assert cls["cmdline"] == ["train", "--data", "t"]
+    # The launch cwd rides the start event so doctor --resume can
+    # re-resolve relative --data/--checkpoint-dir paths correctly.
+    assert cls["cwd"] == os.getcwd()
+    run.finish()
+    assert classify_run(run.path)["effective_status"] == "FINISHED"
+    events = [e["event"] for e in read_journal(run.path)]
+    assert events == ["start", "checkpoint", "finish"]
+
+
+def test_config_event_alone_makes_run_revivable(tmp_path):
+    """A run killed during startup (or inside its FIRST save window)
+    has no committed-step events — the fit-start ``config`` event must
+    still hand the doctor its checkpoint dir so --resume can revive it
+    as a fresh --resume-auto start."""
+    run_dir = tmp_path / "exp" / "r"
+    run_dir.mkdir(parents=True)
+    (run_dir / "meta.json").write_text(json.dumps(
+        {"experiment": "exp", "run_id": "r", "status": "RUNNING",
+         "start_time": 1.0}
+    ))
+    (run_dir / JOURNAL_NAME).write_text(
+        json.dumps({"event": "start", "pid": _dead_pid(), "boot_id": "",
+                    "time": 1.0, "cmdline": ["train", "--data", "d"]})
+        + "\n"
+        + json.dumps({"event": "config", "checkpoint_dir": "/ckpt",
+                      "time": 1.1}) + "\n"
+    )
+    cls = classify_run(run_dir)
+    assert cls["effective_status"] == "INTERRUPTED"
+    assert cls["checkpoint_dir"] == "/ckpt" and cls["last_step"] is None
+
+
+def _fake_dead_run(root: Path, experiment: str, run_id: str, *,
+                   checkpoint_dir: str | None = None,
+                   cmdline: list | None = None,
+                   trial_events: list | None = None) -> Path:
+    """A RUNNING run whose journaled PID is dead — what any hard kill
+    leaves behind."""
+    run_dir = root / experiment / run_id
+    (run_dir / "artifacts").mkdir(parents=True)
+    (run_dir / "meta.json").write_text(json.dumps({
+        "experiment": experiment, "run_id": run_id, "run_name": run_id,
+        "status": "RUNNING", "start_time": 1.0,
+    }))
+    events = [{"event": "start", "pid": _dead_pid(), "boot_id": "",
+               "time": 1.0, **({"cmdline": cmdline} if cmdline else {})}]
+    if checkpoint_dir:
+        events.append({"event": "checkpoint", "step": 3,
+                       "checkpoint_dir": checkpoint_dir, "time": 2.0})
+    events.extend(trial_events or [])
+    (run_dir / JOURNAL_NAME).write_text(
+        "".join(json.dumps(e) + "\n" for e in events)
+    )
+    return run_dir
+
+
+def test_doctor_marks_dead_runs_and_reports_resumable(tmp_path, capsys):
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    # A finished run, a dead RUNNING run with a resumable checkpoint
+    # (manifest-intact step), and a stranded tmp to collect.
+    root = tmp_path / "runs"
+    with RunStore(root, "exp", run_name="ok"):
+        pass
+    ckpt = tmp_path / "ckpt"
+    step = ckpt / "3"
+    step.mkdir(parents=True)
+    (step / "w.bin").write_bytes(b"x" * 64)
+    from dss_ml_at_scale_tpu.resilience import write_manifest
+
+    write_manifest(step)
+    dead = _fake_dead_run(root, "exp", "deadrun",
+                          checkpoint_dir=str(ckpt))
+    (dead / "params.json.tmp").write_text("torn")
+
+    before = _counter("runs_interrupted_total")
+    assert main(["runs", "doctor", "--tracking-root", str(root)]) == 0
+    out = capsys.readouterr().out
+    assert "deadrun: INTERRUPTED" in out and "resumable: step 3" in out
+    assert _counter("runs_interrupted_total") - before == 1
+    assert json.loads(
+        (dead / "meta.json").read_text()
+    )["status"] == "INTERRUPTED"
+    assert not (dead / "params.json.tmp").exists()
+    assert read_journal(dead)[-1]["event"] == "interrupted"
+
+    # list_runs renders the doctored status; a second sweep is a no-op.
+    statuses = {m["run_id"]: m["status"] for m in list_runs(root)}
+    assert statuses["deadrun"] == "INTERRUPTED"
+    assert sum(
+        1 for c in sweep_interrupted(root) if c.get("marked")
+    ) == 0
+
+
+def test_list_runs_renders_dead_running_as_interrupted_without_marking(
+    tmp_path,
+):
+    root = tmp_path / "runs"
+    _fake_dead_run(root, "exp", "deadrun")
+    meta = {m["run_id"]: m for m in list_runs(root)}["deadrun"]
+    assert meta["status"] == "INTERRUPTED" and meta["live"] is False
+    # Render-only: the stored meta is untouched until a doctor sweep.
+    assert json.loads(
+        (root / "exp" / "deadrun" / "meta.json").read_text()
+    )["status"] == "RUNNING"
+
+
+def test_doctor_resume_argv_rewrite():
+    from dss_ml_at_scale_tpu.config.commands import _resume_argv
+
+    argv = _resume_argv([
+        "--platform", "cpu", "--fault-plan", "fs.torn_write=1",
+        "train", "--data", "d", "--resume-auto",
+    ])
+    assert argv == ["--platform", "cpu", "train", "--data", "d",
+                    "--resume-auto"]
+    assert _resume_argv(["train", "--data", "d"])[-1] == "--resume-auto"
+    assert _resume_argv(["serve", "--checkpoint-dir", "c"]) is None
+
+
+# -- Trainer --resume-auto ----------------------------------------------------
+
+
+def _fit_resume_auto(tmp_path, *, max_epochs, steps_per_epoch=3,
+                     batches=None, task=None):
+    from dss_ml_at_scale_tpu.parallel import Trainer, TrainerConfig
+    from dss_ml_at_scale_tpu.runtime import make_mesh
+    from test_resilience import _tiny_task
+    from test_trainer import synthetic_batches
+
+    trainer = Trainer(
+        TrainerConfig(
+            max_epochs=max_epochs,
+            steps_per_epoch=steps_per_epoch,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            keep_checkpoints=4,
+            limit_val_batches=2,
+            resume_auto=True,
+            log_every_steps=1000,
+        ),
+        mesh=make_mesh(),
+    )
+    return trainer.fit(
+        task if task is not None else _tiny_task(),
+        iter(batches if batches is not None
+             else synthetic_batches(steps_per_epoch * max_epochs)),
+    )
+
+
+def test_resume_auto_matches_explicit_resume_and_meters(tmp_path,
+                                                        devices8):
+    """--resume-auto step parity: restores exactly the step an explicit
+    --resume would, and counts auto_resume_total."""
+    from test_resilience import _fit, _tiny_task
+    from test_trainer import synthetic_batches
+
+    task = _tiny_task()
+    _fit(tmp_path, max_epochs=2, task=task)  # saves steps 3, 6
+    before = _counter("auto_resume_total")
+    r_auto = _fit_resume_auto(
+        tmp_path, max_epochs=3, task=task, batches=synthetic_batches(9),
+    )
+    assert _counter("auto_resume_total") - before == 1
+    r_explicit = _fit(
+        tmp_path, max_epochs=3, resume=True, task=task,
+        batches=synthetic_batches(9),
+    )
+    # Auto resumed 6 -> 9; the explicit resume then restored that same 9.
+    assert int(r_auto.state.step) == 9
+    assert int(r_explicit.state.step) == 9
+
+
+def test_resume_auto_on_empty_dir_starts_fresh(tmp_path, devices8):
+    r = _fit_resume_auto(tmp_path, max_epochs=1)
+    assert int(r.state.step) == 3
+    before = _counter("auto_resume_total")
+    # And with checkpoints now present, it restores instead.
+    r2 = _fit_resume_auto(tmp_path, max_epochs=1)
+    assert int(r2.state.step) == 3
+    assert _counter("auto_resume_total") - before == 1
+
+
+def test_resume_auto_falls_back_past_torn_step_and_repairs_proof(
+    tmp_path, devices8
+):
+    """The save-window-kill aftermath, deterministically: the newest
+    step lost its manifest (killed mid-publish) AND its pages (torn
+    data). resume-auto falls back to the previous intact step,
+    quarantines the wreck, re-runs, and ends at full step count."""
+    from test_resilience import _corrupt_step, _fit, _tiny_task
+    from test_trainer import synthetic_batches
+
+    task = _tiny_task()
+    _fit(tmp_path, max_epochs=2, task=task)  # steps 3, 6 with manifests
+    ckpt = tmp_path / "ckpt"
+    # The mid-manifest-write kill: manifest gone (never published), a
+    # stranded manifest tmp, and the step's biggest file zero-torn (the
+    # pages that never hit disk).
+    _corrupt_step(ckpt, 6)
+    (ckpt / "6" / MANIFEST_NAME).rename(
+        ckpt / "6" / (MANIFEST_NAME + ".tmp")
+    )
+    before = _counter("checkpoint_fallback_total")
+    r = _fit_resume_auto(
+        tmp_path, max_epochs=2, task=task, batches=synthetic_batches(6),
+    )
+    assert int(r.state.step) == 6  # fell back to 3, re-ran epoch 1
+    assert _counter("checkpoint_fallback_total") - before >= 1
+    assert any(
+        p.name.startswith("6.corrupt") for p in ckpt.iterdir()
+    ), "torn step was not quarantined"
+    # The re-saved step 6 and the repaired step 3 both verify intact;
+    # no stranded tmps anywhere (the resume swept them).
+    assert verify_step(ckpt / "6")[0] == "intact"
+    assert verify_step(ckpt / "3")[0] == "intact"
+    assert not [
+        p for p in ckpt.rglob("*.tmp")
+        if ".corrupt" not in str(p.parent)
+    ]
+
+
+def test_resume_auto_with_nothing_restorable_starts_fresh(tmp_path,
+                                                          devices8):
+    """Every step torn -> quarantine the wreckage, converge to a fresh
+    run instead of erroring (explicit --resume keeps erroring)."""
+    import shutil
+
+    from test_resilience import _corrupt_step, _fit, _tiny_task
+    from test_trainer import synthetic_batches
+
+    task = _tiny_task()
+    _fit(tmp_path, max_epochs=1, task=task)  # one step: 3
+    ckpt = tmp_path / "ckpt"
+    _corrupt_step(ckpt, 3)
+    with pytest.raises(FileNotFoundError):
+        _fit(tmp_path, max_epochs=1, resume=True, task=task,
+             batches=synthetic_batches(3))
+    r = _fit_resume_auto(
+        tmp_path, max_epochs=1, task=task, batches=synthetic_batches(3),
+    )
+    assert int(r.state.step) == 3
+    assert any(p.name.startswith("3.corrupt") for p in ckpt.iterdir())
+    assert verify_step(ckpt / "3")[0] == "intact"
+
+
+# -- dsst hpo --resume-auto ---------------------------------------------------
+
+
+def test_hpo_resume_auto_continues_from_journaled_trials(tmp_path,
+                                                         capsys):
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    root = tmp_path / "runs"
+    assert main([
+        "hpo", "--bytes", "2e4", "--parallelism", "1",
+        "--max-evals", "2", "--tracking-root", str(root),
+        "--experiment", "hx",
+    ]) == 0
+    capsys.readouterr()
+    # Simulate the kill: the finished run becomes a dead RUNNING run.
+    run_dir = next((root / "hx").iterdir())
+    meta = json.loads((run_dir / "meta.json").read_text())
+    meta["status"] = "RUNNING"
+    meta.pop("end_time", None)
+    (run_dir / "meta.json").write_text(json.dumps(meta))
+    start = json.loads(
+        (run_dir / JOURNAL_NAME).read_text().splitlines()[0]
+    )
+    start["pid"] = _dead_pid()
+    events = [start] + [
+        json.loads(l)
+        for l in (run_dir / JOURNAL_NAME).read_text().splitlines()[1:]
+        if json.loads(l)["event"] == "trial"
+    ]
+    (run_dir / JOURNAL_NAME).write_text(
+        "".join(json.dumps(e) + "\n" for e in events)
+    )
+
+    assert main([
+        "hpo", "--bytes", "2e4", "--parallelism", "1",
+        "--max-evals", "4", "--tracking-root", str(root),
+        "--experiment", "hx", "--resume-auto",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "continuing from 2 journaled trial(s)" in out
+    assert "best alpha" in out
+    # The resumed run journaled ONLY the new trials (tids 2, 3).
+    new_run = max((root / "hx").iterdir(), key=lambda p: p.stat().st_mtime)
+    tids = [e["tid"] for e in read_journal(new_run)
+            if e["event"] == "trial"]
+    assert sorted(tids) == [2, 3]
+    # And the interrupted predecessor was doctored terminal.
+    assert json.loads(
+        (run_dir / "meta.json").read_text()
+    )["status"] == "INTERRUPTED"
+
+
+# -- the acceptance soak ------------------------------------------------------
+
+
+def _run_soak(workdir, *, cycles, seed, epochs, kill_max, timeout=240.0):
+    from dss_ml_at_scale_tpu.resilience.chaos import ChaosConfig, run_chaos
+
+    return run_chaos(ChaosConfig(
+        workdir=str(workdir), cycles=cycles, seed=seed,
+        kill_min_s=1.0, kill_max_s=kill_max, epochs=epochs,
+        rows=48, batch_size=16, image_size=32, timeout_s=timeout,
+    ))
+
+
+def _assert_soak(report, min_kills):
+    problems = {
+        name: res for name, res in report["invariants"].items()
+        if not res.get("ok")
+    }
+    assert report["ok"], json.dumps(problems, indent=1)
+    assert report["kills_delivered"] >= min_kills
+    # At least one kill landed inside the checkpoint-save window, via
+    # the kN fs.* site (the child SIGKILLed itself mid-manifest-publish).
+    assert report["invariants"]["save_window_kill"]["ok"]
+    assert report["invariants"]["params_bitwise_equal"]["chaos"][
+        "digest"
+    ] == report["invariants"]["params_bitwise_equal"]["ref"]["digest"]
+
+
+def test_chaos_soak_train_five_sigkill_cycles(tmp_path):
+    """Acceptance: a seeded `dsst chaos` soak — 5 SIGKILL cycles against
+    `dsst train` (one inside the save window via fs.*), auto-resume
+    between cycles — converges: final params bitwise-identical to the
+    uninterrupted same-seed run, manifest walk clean, zero stranded
+    tmps, every run terminal."""
+    report = _run_soak(
+        tmp_path / "soak", cycles=5, seed=0, epochs=2, kill_max=3.0,
+    )
+    assert_kills = 5
+    _assert_soak(report, assert_kills)
+
+
+@pytest.mark.slow
+def test_chaos_soak_long(tmp_path):
+    """The minute-plus soak: more cycles, longer runs, wider kill
+    window, plus an hpo soak and serve restart cycles on the trained
+    checkpoint."""
+    from dss_ml_at_scale_tpu.resilience.chaos import ChaosConfig, run_chaos
+
+    report = _run_soak(
+        tmp_path / "soak", cycles=8, seed=7, epochs=3, kill_max=6.0,
+        timeout=400.0,
+    )
+    _assert_soak(report, 6)
+
+    hpo = run_chaos(ChaosConfig(
+        workdir=str(tmp_path / "hpo_soak"), workload="hpo", cycles=3,
+        seed=1, kill_min_s=1.0, kill_max_s=4.0, max_evals=6,
+        timeout_s=240.0,
+    ))
+    assert hpo["ok"], json.dumps(hpo["invariants"], indent=1)
+
+    serve = run_chaos(ChaosConfig(
+        workdir=str(tmp_path / "serve_soak"), workload="serve", cycles=2,
+        checkpoint_dir=str(tmp_path / "soak" / "ckpt"), timeout_s=120.0,
+    ))
+    assert serve["ok"], json.dumps(serve["invariants"], indent=1)
+
+
+def test_chaos_cli_json_report(tmp_path, capsys):
+    """`dsst chaos --json`: the CLI face emits the machine-readable
+    report and exits by the verdict (tiny 1-cycle soak)."""
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    rc = main([
+        "chaos", "--workdir", str(tmp_path / "c"), "--cycles", "1",
+        "--seed", "2", "--epochs", "1", "--kill-max", "2.0", "--json",
+    ])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == (0 if report["ok"] else 1)
+    assert report["workload"] == "train"
+    assert "params_bitwise_equal" in report["invariants"]

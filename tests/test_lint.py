@@ -33,6 +33,9 @@ from dss_ml_at_scale_tpu.analysis import (
 from dss_ml_at_scale_tpu.analysis.checkers.bare_except import (
     BareExceptChecker,
 )
+from dss_ml_at_scale_tpu.analysis.checkers.durable_write import (
+    DurableWriteChecker,
+)
 from dss_ml_at_scale_tpu.analysis.checkers.fault_sites import (
     FaultSitesChecker,
 )
@@ -101,12 +104,17 @@ def test_fault_sites_clean():
     _rule_clean("fault-sites")
 
 
+def test_durable_write_clean():
+    _rule_clean("durable-write")
+
+
 # -- per-rule fixtures --------------------------------------------------------
 
 # rule -> (checker factory, expected positive finding count)
 RULES = {
     "no_print": (lambda: NoPrintChecker(), 2),
     "bare_except": (lambda: BareExceptChecker(), 3),
+    "durable_write": (lambda: DurableWriteChecker(), 6),
     "fault_sites_pos": (
         lambda: FaultSitesChecker(known={"reader.next": "doc"}), 3,
     ),

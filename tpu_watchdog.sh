@@ -44,8 +44,18 @@ jnp.zeros((8, 8)).sum().block_until_ready()
 print("CLAIM OK", d.platform, d.device_kind, flush=True)
 PY
   if [ $? -eq 0 ]; then
-    echo "$(date -u +%H:%M:%S) tunnel up -> bench" >> tpu_watchdog.log
+    echo "$(date -u +%H:%M:%S) tunnel up -> doctor + bench" >> tpu_watchdog.log
     sleep 10
+    # Crash-only revival FIRST: a watchdog restart usually means the VM
+    # (or the tunnel) died under a run. The doctor sweeps the run store,
+    # marks dead-PID runs INTERRUPTED, and re-executes each interrupted
+    # run's recorded command with --resume-auto — so a recovered TPU VM
+    # re-enters training from the newest intact checkpoint instead of
+    # idling until a human notices. Bounded so a pathological resume
+    # cannot eat the bench window.
+    timeout 3600 python -m dss_ml_at_scale_tpu.config.cli \
+      runs doctor --resume >> tpu_watchdog.log 2>&1
+    echo "$(date -u +%H:%M:%S) runs doctor --resume rc=$?" >> tpu_watchdog.log
     DSST_BENCH_TIMEOUT=2400 DSST_BENCH_GROUP_TIMEOUT=1500 DSST_BENCH_LM_TIMEOUT=1200 \
       DSST_BENCH_VIT=1 \
       timeout 14400 python bench.py > BENCH_onchip_r5.json 2> bench_onchip_stderr.log
