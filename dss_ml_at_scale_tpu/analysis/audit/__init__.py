@@ -1,0 +1,50 @@
+"""``dsst audit`` — the IR-level analysis tier.
+
+Where ``dsst lint`` reads Python ASTs, this package abstractly traces
+the registry of real compiled entrypoints (:mod:`.entrypoints`) and
+audits the jaxpr/StableHLO/optimized-HLO they lower to: donation,
+dtype discipline, sharding/collectives, host interop, and a
+content-addressed compiled-program baseline (``AUDIT_BASELINE.json``).
+See :mod:`.core` for the framework and :mod:`.rules` for the rules.
+"""
+
+from .core import (
+    AUDIT_SCHEMA_VERSION,
+    COST_TOLERANCE,
+    DEFAULT_AUDIT_BASELINE,
+    AuditFinding,
+    AuditResult,
+    AuditRule,
+    AuditUsageError,
+    EntrypointContext,
+    ProgramSpec,
+    default_audit_mesh,
+    load_audit_baseline,
+    register_rule,
+    rule_catalog,
+    rule_names,
+    run_audit,
+    write_audit_baseline,
+)
+from .entrypoints import builders, entrypoint_names
+
+__all__ = [
+    "AUDIT_SCHEMA_VERSION",
+    "COST_TOLERANCE",
+    "DEFAULT_AUDIT_BASELINE",
+    "AuditFinding",
+    "AuditResult",
+    "AuditRule",
+    "AuditUsageError",
+    "EntrypointContext",
+    "ProgramSpec",
+    "builders",
+    "default_audit_mesh",
+    "entrypoint_names",
+    "load_audit_baseline",
+    "register_rule",
+    "rule_catalog",
+    "rule_names",
+    "run_audit",
+    "write_audit_baseline",
+]

@@ -67,6 +67,9 @@ class FaultSitesChecker(Checker):
         "resilience.faults.KNOWN_SITES, and no registered site is dead"
     )
     roots = ("package",)
+    # used⊆registered ∧ registered⊆used needs every use site in view;
+    # a changed-files subset would declare live sites dead.
+    full_scan_only = True
 
     def __init__(self, known: dict | None = None):
         # Default to the LIVE registry — the lint must test what ships,

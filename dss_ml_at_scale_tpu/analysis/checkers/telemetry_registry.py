@@ -48,6 +48,9 @@ class TelemetryRegistryChecker(Checker):
         "declared metric is dead"
     )
     roots = ("package",)
+    # Reconciles BOTH directions against the catalog: a partial scan
+    # would report every out-of-scope call site as a dead entry.
+    full_scan_only = True
 
     def __init__(self, known: dict | None = None):
         if known is None:

@@ -220,6 +220,11 @@ class Checker:
     name: str = ""
     description: str = ""
     roots: tuple[str, ...] = ("package",)
+    # Registry-reconciling checkers (finalize() compares call sites
+    # against a catalog across ALL files) misfire on partial scans:
+    # a file outside the subset looks like a missing call site. They
+    # declare full_scan_only and are skipped by ``dsst lint --changed``.
+    full_scan_only: bool = False
 
     def wants(self, ctx: FileContext) -> bool:
         return ctx.root in self.roots
@@ -436,23 +441,55 @@ def default_roots() -> list[tuple[str, Path]]:
     return [("package", PACKAGE_DIR), ("scripts", SCRIPTS_DIR)]
 
 
+def _contexts_for_paths(
+    paths: Sequence[Path],
+    scan_roots: Sequence[tuple[str, Path]],
+) -> Iterable[FileContext]:
+    """Contexts for an explicit file list (``--changed``), attributed
+    to the scan root that contains each file so per-root rule scoping
+    (``Checker.roots``) behaves exactly as in a full scan."""
+    for path in sorted(Path(p).resolve() for p in paths):
+        label = None
+        for lbl, root in scan_roots:
+            try:
+                path.relative_to(Path(root).resolve())
+            except ValueError:
+                continue
+            label = lbl
+            break
+        if label is None:
+            continue  # outside every scan root: not ours to lint
+        try:
+            rel = path.relative_to(REPO_ROOT).as_posix()
+        except ValueError:
+            rel = path.name
+        yield FileContext(
+            path, rel, label, path.read_text(encoding="utf-8")
+        )
+
+
 def run_lint(
     rules: Sequence[str] | None = None,
     *,
     roots: Sequence[tuple[str, Path]] | None = None,
     baseline_path: Path | None = None,
     checkers: Sequence[Checker] | None = None,
+    paths: Sequence[Path] | None = None,
 ) -> LintResult:
     """Run the suite; the single entry point the CLI, tier-1 test, and
     script shims all share.
 
     ``rules`` selects a subset (default: all registered). ``checkers``
     overrides instantiation entirely (tests inject checkers with fake
-    registries). Baseline staleness is judged only against the selected
-    rules — ``--rules no-print`` must not declare every other rule's
-    entries stale.
+    registries). ``paths`` restricts the scan to an explicit file list
+    (``dsst lint --changed``): full-scan-only checkers are dropped, and
+    baseline staleness is judged only against the scanned files.
+    Baseline staleness is judged only against the selected rules —
+    ``--rules no-print`` must not declare every other rule's entries
+    stale.
     """
     _load_plugins()
+    explicit_rules = checkers is None and bool(rules)
     if checkers is None:
         names = list(rules) if rules else sorted(_CHECKERS)
         unknown = [n for n in names if n not in _CHECKERS]
@@ -462,6 +499,17 @@ def run_lint(
                 f"known: {', '.join(sorted(_CHECKERS))}"
             )
         checkers = [_CHECKERS[n]() for n in names]
+    if paths is not None:
+        dropped = sorted(c.name for c in checkers if c.full_scan_only)
+        if dropped and explicit_rules:
+            # Silently skipping a rule the user NAMED would report a
+            # clean pass for a check that never ran.
+            raise LintUsageError(
+                f"rule(s) {', '.join(dropped)} reconcile a full registry "
+                "and cannot run on a --changed subset; drop them from "
+                "--rules or run a full lint"
+            )
+        checkers = [c for c in checkers if not c.full_scan_only]
     selected = [c.name for c in checkers]
 
     scan_roots = list(roots) if roots is not None else default_roots()
@@ -471,17 +519,22 @@ def run_lint(
     # stale (otherwise dead entries linger, and a re-added file with the
     # same flagged line would silently inherit the exemption).
     root_prefixes: list[str] = []
-    for _, root in scan_roots:
-        try:
-            root_prefixes.append(
-                Path(root).resolve().relative_to(REPO_ROOT).as_posix() + "/"
-            )
-        except ValueError:
-            pass  # foreign tree (fixtures): can't attribute entries to it
+    if paths is None:
+        for _, root in scan_roots:
+            try:
+                root_prefixes.append(
+                    Path(root).resolve().relative_to(REPO_ROOT).as_posix()
+                    + "/"
+                )
+            except ValueError:
+                pass  # foreign tree (fixtures): can't attribute entries
     contexts: dict[str, FileContext] = {}
     raw: list[Finding] = []
     suppressed: list[Finding] = []
-    for ctx in iter_contexts(scan_roots):
+    for ctx in (
+        iter_contexts(scan_roots) if paths is None
+        else _contexts_for_paths(paths, scan_roots)
+    ):
         contexts[ctx.rel] = ctx
         # Reasonless suppression comments are findings of the framework
         # itself — rule "suppression", not suppressible (a suppression

@@ -2327,6 +2327,14 @@ def register_lint(sub: argparse._SubParsersAction) -> None:
         "--list-rules", action="store_true",
         help="print the rule catalog and exit",
     )
+    ln.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None, metavar="REF",
+        help="lint only files changed vs the given git ref (default "
+        "HEAD: staged+unstaged+untracked) — the fast pre-commit mode. "
+        "Whole-package registry rules (telemetry-registry, fault-sites) "
+        "are skipped: they reconcile call sites against a registry "
+        "across ALL files and would misfire on a subset",
+    )
     ln.set_defaults(fn=_cmd_lint)
 
 
@@ -2352,7 +2360,22 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         baseline = (
             Path(args.baseline) if args.baseline else DEFAULT_BASELINE
         )
-        res = run_lint(rules, baseline_path=baseline)
+        paths = None
+        if args.changed is not None:
+            if args.update_baseline:
+                raise LintUsageError(
+                    "--changed cannot --update-baseline: a partial scan "
+                    "must never rewrite the whole-package baseline"
+                )
+            paths = _changed_python_files(args.changed)
+            if not paths and not args.json:
+                # --json keeps its machine contract even on an empty
+                # change set: fall through to an empty-scope run so
+                # stdout is still one parseable document.
+                print(f"dsst lint --changed {args.changed}: no changed "
+                      "Python files in scope; nothing to lint")
+                return 0
+        res = run_lint(rules, baseline_path=baseline, paths=paths)
         if args.update_baseline:
             # Everything currently reported (active + already-baselined)
             # becomes the new baseline; stale keys simply don't survive
@@ -2385,6 +2408,170 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         return 2
 
 
+def _changed_python_files(ref: str) -> list:
+    """Package/scripts ``.py`` files changed vs ``ref`` (plus untracked
+    ones) — the ``dsst lint --changed`` scope. Deleted files drop out
+    naturally (they no longer exist to lint)."""
+    import subprocess
+
+    from ..analysis.core import REPO_ROOT, default_roots
+
+    def git(*argv: str) -> list[str]:
+        out = subprocess.run(
+            ["git", *argv], cwd=REPO_ROOT, capture_output=True, text=True,
+        )
+        if out.returncode != 0:
+            from ..analysis import LintUsageError
+
+            raise LintUsageError(
+                f"git {' '.join(argv)} failed: {out.stderr.strip()}"
+            )
+        return [line for line in out.stdout.splitlines() if line.strip()]
+
+    names = set(git("diff", "--name-only", ref))
+    names.update(git("ls-files", "--others", "--exclude-standard"))
+    # Scope to the lint scan roots so --changed and the full scan agree
+    # on what is lintable — derived, not hardcoded, so a new scan root
+    # is picked up here automatically.
+    prefixes = []
+    for _, root in default_roots():
+        try:
+            rel = Path(root).resolve().relative_to(REPO_ROOT).as_posix()
+        except ValueError:
+            continue
+        prefixes.append(rel + "/")
+    out = []
+    for name in sorted(names):
+        p = REPO_ROOT / name
+        if p.suffix == ".py" and p.exists() and name.startswith(
+            tuple(prefixes)
+        ):
+            out.append(p)
+    return out
+
+
+def register_audit(sub: argparse._SubParsersAction) -> None:
+    au = sub.add_parser(
+        "audit",
+        help="IR-level program audit: trace the registry of real "
+        "compiled entrypoints on an abstract 8-device mesh and check "
+        "donation, dtypes, collectives, host callbacks, and the "
+        "compiled-program baseline (AUDIT_BASELINE.json)",
+    )
+    au.add_argument(
+        "--entrypoints", default=None, metavar="E1,E2",
+        help="comma-separated subset of registry entrypoints "
+        "(default: all; see --list-entrypoints)",
+    )
+    au.add_argument(
+        "--rules", default=None, metavar="R1,R2",
+        help="comma-separated subset of audit rules (default: all; "
+        "see --list-rules)",
+    )
+    au.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output (schema documented in README "
+        "'Program audit') instead of text",
+    )
+    au.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="program/finding baseline (default: AUDIT_BASELINE.json "
+        "at the repo root)",
+    )
+    au.add_argument(
+        "--update-baseline", action="store_true",
+        help="re-pin every entrypoint's program hash and cost budgets "
+        "to the current build and rewrite accepted findings (existing "
+        "entries keep their authored reason, new ones take --reason)",
+    )
+    au.add_argument(
+        "--reason", default=None, metavar="TEXT",
+        help="justification recorded for entries newly added by "
+        "--update-baseline (mandatory when any exist)",
+    )
+    au.add_argument(
+        "--list-rules", action="store_true",
+        help="print the audit rule catalog and exit",
+    )
+    au.add_argument(
+        "--list-entrypoints", action="store_true",
+        help="print the entrypoint registry and exit",
+    )
+    au.set_defaults(fn=_cmd_audit)
+
+
+def _cmd_audit(args: argparse.Namespace) -> int:
+    # The abstract mesh needs >=8 devices; on a CPU host that means
+    # multiplexing the host platform BEFORE backend init. Setting the
+    # flag is safe even if another backend wins (TPU hosts have >=8
+    # real devices; default_audit_mesh validates either way).
+    import os
+
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag
+        ).strip()
+
+    from ..analysis.audit import (
+        DEFAULT_AUDIT_BASELINE,
+        AuditUsageError,
+        entrypoint_names,
+        load_audit_baseline,
+        rule_catalog,
+        run_audit,
+        write_audit_baseline,
+    )
+
+    try:
+        if args.list_rules:
+            for name, desc in rule_catalog():
+                print(f"{name:22s} {desc}")
+            return 0
+        if args.list_entrypoints:
+            for name in entrypoint_names():
+                print(name)
+            return 0
+        entrypoints = (
+            [e.strip() for e in args.entrypoints.split(",") if e.strip()]
+            if args.entrypoints else None
+        )
+        rules = (
+            [r.strip() for r in args.rules.split(",") if r.strip()]
+            if args.rules else None
+        )
+        baseline = (
+            Path(args.baseline) if args.baseline
+            else DEFAULT_AUDIT_BASELINE
+        )
+        if args.update_baseline and (entrypoints or rules):
+            # Same contract as `lint --changed`: the baseline is a
+            # whole-registry truth. write_audit_baseline rebuilds
+            # 'programs' from this run alone, so a subset update would
+            # silently drop every pin (and, under --rules without
+            # program-baseline, every cost budget) it didn't re-check.
+            raise AuditUsageError(
+                "--update-baseline needs the full audit: an "
+                "--entrypoints/--rules subset must never rewrite the "
+                "whole-registry baseline"
+            )
+        res = run_audit(entrypoints, rules=rules, baseline_path=baseline)
+        if args.update_baseline:
+            old = load_audit_baseline(baseline)
+            added = write_audit_baseline(baseline, res, old, args.reason)
+            print(
+                f"audit baseline {baseline}: {len(res.programs)} "
+                f"program(s) pinned, {added} finding(s) newly accepted, "
+                f"{len(res.stale_baseline)} stale dropped"
+            )
+            return 0
+        print(res.render_json() if args.json else res.render_text())
+        return res.exit_code
+    except AuditUsageError as e:
+        print(f"dsst audit: {e}", file=sys.stderr)
+        return 2
+
+
 def register_all(sub: argparse._SubParsersAction) -> None:
     register_datagen(sub)
     register_forecast(sub)
@@ -2403,6 +2590,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_chaos(sub)
     register_telemetry(sub)
     register_lint(sub)
+    register_audit(sub)
     from .pipeline import register_pipeline
 
     register_pipeline(sub)

@@ -273,6 +273,18 @@ def init_kv_cache(model: TransformerLM, batch: int):
     )
 
 
+def decode_step(model: TransformerLM, variables, tokens, cache, pos):
+    """One KV-cache decode step: ``[b, t]`` tokens at ``pos`` → logits.
+
+    The single-token apply that :func:`generate`'s scan iterates — and
+    the program the ``dsst audit`` registry lowers with the cache
+    donated (the continuous-batching serving tier will hold one live
+    cache per slot, so the step must alias it, not copy it). Factored
+    out so the audited program and the sampling loop can never diverge.
+    """
+    return model.apply(variables, tokens, cache=cache, pos=pos)
+
+
 def generate(
     model: TransformerLM,
     variables,
@@ -338,8 +350,8 @@ def generate(
         cache, logits, key = carry
         key, sub = jax.random.split(key)
         nxt = sample(logits, sub)  # the token at position p + i
-        logits, cache = model.apply(
-            variables, nxt[:, None], cache=cache, pos=p + i
+        logits, cache = decode_step(
+            model, variables, nxt[:, None], cache, p + i
         )
         return (cache, logits, key), nxt
 

@@ -44,7 +44,16 @@ def nelder_mead(
     fatol: float = 1e-4,
 ) -> NelderMeadResult:
     """Minimize ``fn`` (R^n -> R, JAX-traceable) starting at ``x0``."""
-    x0 = jnp.asarray(x0, jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    # A floating x0 keeps its dtype: forcing f64 under x64 split the
+    # simplex from f32 state inside the objective (the Kalman scan
+    # carry), which the x64 lens of `dsst audit` flagged — callers that
+    # want an f64 search pass an f64 start. Non-float starts take the
+    # configuration's default float.
+    x0 = jnp.asarray(x0)
+    if not jnp.issubdtype(x0.dtype, jnp.floating):
+        x0 = x0.astype(
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        )
     n = x0.shape[0]
     simplex = _init_simplex(x0)
     # Non-finite objective values must not poison the simplex ordering.

@@ -310,6 +310,49 @@ class LMTask:
         return {"val_loss": loss, "val_ppl": jnp.exp(loss)}
 
 
+def health_state_shardings(replicated):
+    """The replicated sharding tree for the health supervisor's EWMA
+    carry — the ONE definition :func:`make_train_step`'s out_shardings,
+    ``Trainer.fit``'s ``device_put``, and the audit registry all share,
+    so the carry's placement can never diverge from the jitted
+    program's contract."""
+    return jax.tree_util.tree_map(
+        lambda _: replicated, health.HealthState.create()
+    )
+
+
+def make_train_step(task, state_shardings, replicated, health_cfg=None):
+    """The ONE train-step program constructor.
+
+    ``Trainer.fit`` and ``dsst audit`` both compile exactly this jit —
+    so what the auditor certifies (params+opt_state donation, dtype
+    discipline, collective shapes, the program-baseline hash) is the
+    program production runs, not a parallel reconstruction that could
+    drift. Donating argnum 0 (the :class:`TrainState`) is the contract
+    the audit's ``donation`` rule holds this function to.
+
+    With ``health_cfg`` the SAME task step is wrapped by the health
+    supervisor's commit-or-discard guard and the jitted program carries
+    the (state, HealthState) pair as its donated carry.
+    """
+    if health_cfg is None:
+        return jax.jit(task.train_step, donate_argnums=0,
+                       out_shardings=(state_shardings, replicated))
+    h_shardings = health_state_shardings(replicated)
+    return jax.jit(
+        health.guard_train_step(task.train_step, health_cfg),
+        donate_argnums=0,
+        out_shardings=((state_shardings, h_shardings), replicated),
+    )
+
+
+def make_eval_step(task, replicated):
+    """The eval-step program constructor shared by ``Trainer.fit`` and
+    ``dsst audit`` (eval donates nothing: the state must survive the
+    call)."""
+    return jax.jit(task.eval_step, out_shardings=replicated)
+
+
 @dataclasses.dataclass
 class TrainerConfig:
     max_epochs: int = 2                      # reference MAX_EPOCHS (2...py:343)
@@ -514,23 +557,18 @@ class Trainer:
         )
         hstate = None
         if supervisor is None:
-            train_step = jax.jit(task.train_step, donate_argnums=0,
-                                 out_shardings=(state_shardings, replicated))
+            train_step = make_train_step(task, state_shardings, replicated)
         else:
             # Health-supervised step: the SAME task train_step with the
             # on-device isfinite/z-score signals and the commit-or-
             # discard select fused into the one jitted program. The tiny
             # EWMA HealthState rides the carry, replicated.
-            h_shardings = jax.tree_util.tree_map(
-                lambda _: replicated, health.HealthState.create()
+            train_step = make_train_step(
+                task, state_shardings, replicated, health_cfg=cfg.health
             )
-            train_step = jax.jit(
-                health.guard_train_step(task.train_step, cfg.health),
-                donate_argnums=0,
-                out_shardings=((state_shardings, h_shardings), replicated),
-            )
+            h_shardings = health_state_shardings(replicated)
             hstate = jax.device_put(health.HealthState.create(), h_shardings)
-        eval_step = jax.jit(task.eval_step, out_shardings=replicated)
+        eval_step = make_eval_step(task, replicated)
 
         # Track-best only matters when something produces the metric.
         # Pass the RESOLVED cfg — self.config keeps None sentinels.

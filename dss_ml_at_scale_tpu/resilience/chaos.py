@@ -34,6 +34,17 @@ Kill modes per cycle (seeded by ``ChaosConfig.seed``):
 
 ``dsst chaos`` is the CLI face; the tier-1 suite runs a short seeded
 soak and the ``-m slow`` marker carries the minute-long one.
+
+Concurrency model (the lock-discipline contract of this module): the
+supervisor is deliberately SINGLE-threaded — isolation comes from
+process boundaries, not locks. Children are ``subprocess.Popen`` with
+their own address spaces; the parent's only shared-state channel is
+the filesystem it polls (step dirs, journals), which the durability
+layer already makes safe to read concurrently with a writer. There is
+therefore no ``_guarded_by_lock`` state to declare here, and adding a
+thread to this module means declaring its shared attributes first —
+``dsst lint`` (lock-discipline) flags unguarded mutable module globals
+the moment ``threading`` is imported.
 """
 
 from __future__ import annotations

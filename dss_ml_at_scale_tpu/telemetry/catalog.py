@@ -18,6 +18,9 @@ from __future__ import annotations
 
 # name -> kind ("counter" | "gauge" | "histogram")
 KNOWN_METRICS: dict[str, str] = {
+    # -- analysis ----------------------------------------------------------
+    "audit_entrypoints_total": "counter",
+    "audit_findings_total": "counter",
     # -- checkpointing / resilience ---------------------------------------
     "auto_resume_total": "counter",
     "checkpoint_fallback_total": "counter",

@@ -103,6 +103,13 @@ def pid_alive(pid: int) -> bool:
 class RunStore:
     """One run's param/metric/artifact sink. Cheap, append-only, crash-safe."""
 
+    # The manifest-finalizer thread journals "checkpoint" events through
+    # this store while the fit thread logs metrics (heartbeat throttle)
+    # and the exit/preemption paths race finish() — the journal lock is
+    # the one lock all of that shared state sits under.
+    _guarded_by_lock = ("_last_heartbeat", "_closed")
+    _lock_name = "_journal_lock"
+
     def __init__(
         self,
         root: str | os.PathLike,
@@ -157,20 +164,28 @@ class RunStore:
         if not self.active:
             return
         ts = _now()
-        for name, value in metrics.items():
-            self._metrics.write(
-                json.dumps({"name": name, "value": float(value), "step": step, "ts": ts})
-                + "\n"
-            )
-        self._metrics.flush()
+        lines = "".join(
+            json.dumps({"name": name, "value": float(value), "step": step,
+                        "ts": ts}) + "\n"
+            for name, value in metrics.items()
+        )
+        with self._journal_lock:
+            # finish() flips _closed and closes the handle under this
+            # lock; a fit thread logging during shutdown drops the lines
+            # instead of writing to a closed file.
+            if self._closed:
+                return
+            self._metrics.write(lines)
+            self._metrics.flush()
         self._heartbeat(ts)
 
     def _heartbeat(self, ts: float) -> None:
         """Throttled journal mtime touch: liveness evidence for the
         doctor without an fsync per metric line."""
-        if ts - self._last_heartbeat < _HEARTBEAT_EVERY_S:
-            return
-        self._last_heartbeat = ts
+        with self._journal_lock:
+            if ts - self._last_heartbeat < _HEARTBEAT_EVERY_S:
+                return
+            self._last_heartbeat = ts
         try:
             os.utime(self.path / JOURNAL_NAME)
         except OSError:
@@ -234,9 +249,12 @@ class RunStore:
         """Close the run. Idempotent: a second finish (e.g. the crash
         handler racing a normal close) is a no-op instead of a
         double-close of the metrics handle."""
-        if not self.active or self._closed:
+        if not self.active:
             return
-        self._closed = True
+        with self._journal_lock:
+            if self._closed:
+                return
+            self._closed = True
         self.journal_event("finish", status=status)
         meta = json.loads((self.path / "meta.json").read_text())
         meta.update(status=status, end_time=_now())
@@ -258,10 +276,13 @@ class RunStore:
     def metrics(self) -> list[dict]:
         if not self.active:
             return []
-        if not self._closed:
-            # Read-back while the append handle is still open: flush so
-            # the reader sees every logged line.
-            self._metrics.flush()
+        with self._journal_lock:
+            if not self._closed:
+                # Read-back while the append handle is still open: flush
+                # so the reader sees every logged line. Under the lock:
+                # finish() may close the handle between an unlocked
+                # check and the flush.
+                self._metrics.flush()
         with open(self.path / "metrics.jsonl", encoding="utf-8") as f:
             return [json.loads(line) for line in f if line.strip()]
 

@@ -10,6 +10,22 @@ set -u
 cd "$(dirname "$0")"
 SUFFIX="${1:-}"
 
+# Both analysis tiers BEFORE the claim: a program that fails the AST
+# lint or whose lowered IR breaks a contract (donation dropped, surprise
+# all-gather, program-baseline drift) must never spend scarce chip time.
+# Pinned to cpu so the preflight itself cannot touch (or hang on) the
+# tunnel; `dsst audit` multiplexes 8 virtual devices for the abstract
+# mesh on its own.
+echo "== preflight: dsst lint && dsst audit (cpu, abstract mesh) =="
+if ! JAX_PLATFORMS=cpu timeout 600 python -m dss_ml_at_scale_tpu.config.cli lint; then
+  echo "preflight FAILED: dsst lint dirty - refusing to spend the TPU claim"
+  exit 1
+fi
+if ! JAX_PLATFORMS=cpu timeout 900 python -m dss_ml_at_scale_tpu.config.cli audit; then
+  echo "preflight FAILED: dsst audit dirty - refusing to spend the TPU claim"
+  exit 1
+fi
+
 echo "== probe =="
 timeout 150 python - <<'EOF'
 import jax

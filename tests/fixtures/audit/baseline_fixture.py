@@ -1,0 +1,37 @@
+"""program-baseline twins: two different programs under the SAME
+registry name — pin v1, swap in v2, and the baseline must reopen on
+the hash; a lowered cost budget must reopen on flops.
+
+The matmul is big enough (32x32x32) that the backend's cost model
+reports non-trivial flops, so the budget arm has something to regress.
+"""
+
+from __future__ import annotations
+
+from dss_ml_at_scale_tpu.analysis.audit import ProgramSpec
+
+NAME = "fixture.baseline.prog"
+
+
+def _arg(mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(
+        jnp.ones((32, 32), jnp.float32), NamedSharding(mesh, P())
+    )
+
+
+def build_v1(mesh) -> ProgramSpec:
+    def f(x):
+        return x @ x
+
+    return ProgramSpec(name=NAME, fn=f, args=(_arg(mesh),))
+
+
+def build_v2(mesh) -> ProgramSpec:
+    def f(x):
+        return x @ x + 1.0  # a semantic edit: the hash must reopen
+
+    return ProgramSpec(name=NAME, fn=f, args=(_arg(mesh),))

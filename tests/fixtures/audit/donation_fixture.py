@@ -1,0 +1,62 @@
+"""donation twins: a train-step-like carry that should alias its state.
+
+Positive: the registry declares arg 0 donated (``expect_donated``) but
+the jit forgot ``donate_argnums`` — the exact drift between contract
+and code the rule exists to catch. Negative: donation declared AND
+passed to the jit WITH pinned ``out_shardings``, so every state leaf
+carries ``tf.aliasing_output`` in the lowered IR. (With committed
+inputs and unspecified outputs jax only stamps ``jax.buffer_donor`` —
+"may donate" — and defers aliasing to compile time; the rule treats
+that as un-audited donation, which is how it caught the decode step's
+silently dropped cache alias.)
+"""
+
+from __future__ import annotations
+
+from dss_ml_at_scale_tpu.analysis.audit import ProgramSpec
+
+
+def _step(state, batch):
+    new_state = state + batch.sum()
+    loss = (state * state).mean()
+    return new_state, loss
+
+
+def _parts(mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    replicated = NamedSharding(mesh, P())
+    args = (
+        jax.device_put(jnp.zeros((16, 16), jnp.float32), replicated),
+        jax.device_put(jnp.ones((16,), jnp.float32), replicated),
+    )
+    return args, replicated
+
+
+def build_positive(mesh) -> ProgramSpec:
+    args, replicated = _parts(mesh)
+    return ProgramSpec(
+        name="fixture.donation.pos",
+        fn=_step,
+        args=args,
+        # donate_argnums forgotten; out_shardings pinned as in
+        # production, so THE missing piece is donation alone.
+        jit_kwargs={"out_shardings": (replicated, replicated)},
+        expect_donated=(0,),
+    )
+
+
+def build_negative(mesh) -> ProgramSpec:
+    args, replicated = _parts(mesh)
+    return ProgramSpec(
+        name="fixture.donation.neg",
+        fn=_step,
+        args=args,
+        jit_kwargs={
+            "donate_argnums": 0,
+            "out_shardings": (replicated, replicated),
+        },
+        expect_donated=(0,),
+    )

@@ -1,0 +1,66 @@
+"""dtype-discipline twins: latent f64 promotion and weak-type churn.
+
+Positive (wide): an unpinned ``np.linspace`` constant — f64 — meets an
+f32 tensor. The production config canonicalizes it away silently; the
+x64 lens makes the promotion visible as tensor-sized f64 eqns.
+Positive (churn): a loop re-canonicalizing weak scalars into the hot
+body, one same-dtype ``convert_element_type`` per iteration (integer
+typed so the x64 lens adds no f64 noise on top).
+Negative: the same computations with dtypes pinned at the source.
+"""
+
+from __future__ import annotations
+
+from dss_ml_at_scale_tpu.analysis.audit import ProgramSpec
+
+
+def _f32_arg(mesh, shape=(16,)):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(
+        jnp.zeros(shape, jnp.float32), NamedSharding(mesh, P())
+    )
+
+
+def build_positive_wide(mesh) -> ProgramSpec:
+    import numpy as np
+
+    def f(x):
+        # np.linspace is float64; under x64 the mul promotes.
+        return x * np.linspace(0.0, 1.0, x.shape[0])
+
+    return ProgramSpec(
+        name="fixture.dtype.wide.pos", fn=f, args=(_f32_arg(mesh),)
+    )
+
+
+def build_positive_churn(mesh) -> ProgramSpec:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def f(x):
+        for _ in range(12):  # budget is 8
+            weak = lax.full((16,), 1)  # weak i32
+            x = x + lax.convert_element_type(weak, jnp.int32)
+        return x
+
+    arg = jax.device_put(
+        jnp.zeros((16,), jnp.int32), NamedSharding(mesh, P())
+    )
+    return ProgramSpec(name="fixture.dtype.churn.pos", fn=f, args=(arg,))
+
+
+def build_negative(mesh) -> ProgramSpec:
+    import numpy as np
+
+    def f(x):
+        # Pinned at the source: stays f32 under any lens.
+        return x * np.linspace(0.0, 1.0, x.shape[0]).astype(np.float32)
+
+    return ProgramSpec(
+        name="fixture.dtype.neg", fn=f, args=(_f32_arg(mesh),)
+    )

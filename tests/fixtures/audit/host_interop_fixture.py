@@ -1,0 +1,52 @@
+"""host-interop twins: a debug callback left inside a compiled hot path.
+
+Positive: ``jax.debug.print`` in the traced body — a host round-trip
+per step. Negative: the same program marked ``hotpath=False`` (the
+declared escape hatch for diagnostics entrypoints). Suppressed: the
+hot-path program with a reasoned per-entrypoint suppression, the
+IR-tier ``# dsst: ignore`` analogue.
+"""
+
+from __future__ import annotations
+
+from dss_ml_at_scale_tpu.analysis.audit import ProgramSpec
+
+
+def _noisy(x):
+    import jax
+
+    jax.debug.print("sum={s}", s=x.sum())
+    return x * 2.0
+
+
+def _arg(mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(
+        jnp.zeros((16,), jnp.float32), NamedSharding(mesh, P())
+    )
+
+
+def build_positive(mesh) -> ProgramSpec:
+    return ProgramSpec(
+        name="fixture.host_interop.pos", fn=_noisy, args=(_arg(mesh),)
+    )
+
+
+def build_negative(mesh) -> ProgramSpec:
+    return ProgramSpec(
+        name="fixture.host_interop.neg", fn=_noisy, args=(_arg(mesh),),
+        hotpath=False,
+    )
+
+
+def build_suppressed(mesh) -> ProgramSpec:
+    return ProgramSpec(
+        name="fixture.host_interop.suppressed", fn=_noisy,
+        args=(_arg(mesh),),
+        suppress={
+            "host-interop": "demo fixture: callback accepted knowingly"
+        },
+    )

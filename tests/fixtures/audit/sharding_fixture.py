@@ -1,0 +1,66 @@
+"""sharding-collectives twins: the surprise all-gather and the
+oversized replicated input.
+
+Positive (gather): a data-sharded tensor forced replicated at the
+output — the only way GSPMD can satisfy that contract is a full
+all-gather (2 MiB > the 1 MiB default ceiling). Positive (replicated):
+an input held full-copy on every device past the entrypoint's declared
+ceiling (the fixture pins it low so the twin stays tiny). Negative:
+sharded in, sharded out, elementwise — no collective anywhere.
+"""
+
+from __future__ import annotations
+
+from dss_ml_at_scale_tpu.analysis.audit import ProgramSpec
+
+
+def _double(x):
+    return x * 2.0
+
+
+def build_positive_gather(mesh) -> ProgramSpec:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharded = NamedSharding(mesh, P("data"))
+    # 8*256*256*4 = 2 MiB: over the 1 MiB all-gather default ceiling.
+    arg = jax.device_put(jnp.zeros((8, 256, 256), jnp.float32), sharded)
+    return ProgramSpec(
+        name="fixture.sharding.gather.pos",
+        fn=_double,
+        args=(arg,),
+        jit_kwargs={"out_shardings": NamedSharding(mesh, P())},
+    )
+
+
+def build_positive_replicated(mesh) -> ProgramSpec:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # 64*64*4 = 16 KiB fully replicated, ceiling pinned at 1 KiB.
+    arg = jax.device_put(
+        jnp.zeros((64, 64), jnp.float32), NamedSharding(mesh, P())
+    )
+    return ProgramSpec(
+        name="fixture.sharding.replicated.pos",
+        fn=_double,
+        args=(arg,),
+        replicated_bytes_limit=1024,
+    )
+
+
+def build_negative(mesh) -> ProgramSpec:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharded = NamedSharding(mesh, P("data"))
+    arg = jax.device_put(jnp.zeros((8, 256, 256), jnp.float32), sharded)
+    return ProgramSpec(
+        name="fixture.sharding.neg",
+        fn=_double,
+        args=(arg,),
+        jit_kwargs={"out_shardings": sharded},
+    )

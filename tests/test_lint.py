@@ -521,3 +521,139 @@ def test_cli_lint_list_rules(capsys):
                  "lock-discipline", "telemetry-registry", "no-print",
                  "bare-except", "fault-sites"):
         assert rule in out
+
+
+# -- dsst lint --changed ------------------------------------------------------
+
+
+def test_changed_paths_scope_the_scan(tmp_path):
+    """An explicit file list lints exactly those files — the fast
+    pre-commit mode — with per-root rule scoping intact."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("def f(x):\n    print(x)\n")
+    (pkg / "b.py").write_text("def g(x):\n    print(x)\n")
+    roots = [("package", pkg)]
+    bl = tmp_path / "baseline.json"
+    full = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    assert len(full.findings) == 2
+    sub = run_lint(
+        ["no-print"], roots=roots, baseline_path=bl,
+        paths=[pkg / "a.py"],
+    )
+    assert [f.path for f in sub.findings] == ["a.py"]
+
+
+def test_changed_ignores_files_outside_every_root(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    foreign = tmp_path / "foreign.py"
+    foreign.write_text("print('not ours')\n")
+    res = run_lint(
+        ["no-print"], roots=[("package", pkg)],
+        baseline_path=tmp_path / "baseline.json", paths=[foreign],
+    )
+    assert res.findings == []
+
+
+def test_changed_drops_full_scan_only_checkers(tmp_path):
+    """Registry-reconciling rules (telemetry-registry, fault-sites)
+    misfire on partial scans — the default all-rules run must skip
+    them, not report every out-of-scope call site as a dead registry
+    entry."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("def f(x):\n    return x\n")
+    res = run_lint(
+        None,
+        roots=[("package", pkg)],
+        baseline_path=tmp_path / "baseline.json",
+        paths=[pkg / "a.py"],
+    )
+    assert "telemetry-registry" not in res.rules
+    assert "fault-sites" not in res.rules
+    assert "no-print" in res.rules
+    assert res.findings == []
+
+
+def test_changed_explicit_full_scan_only_rule_is_a_usage_error(tmp_path):
+    """Silently skipping a rule the user NAMED would report a clean
+    pass for a check that never ran — that has to be exit 2, not 0."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("def f(x):\n    return x\n")
+    with pytest.raises(LintUsageError, match="full registry"):
+        run_lint(
+            ["telemetry-registry", "no-print"],
+            roots=[("package", pkg)],
+            baseline_path=tmp_path / "baseline.json",
+            paths=[pkg / "a.py"],
+        )
+
+
+def test_changed_does_not_stale_unscanned_baseline_entries(tmp_path):
+    """A partial scan can't prove an out-of-scope baseline entry stale."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("def f(x):\n    print(x)\n")
+    (pkg / "b.py").write_text("def g(x):\n    return x\n")
+    roots = [("package", pkg)]
+    bl = tmp_path / "baseline.json"
+    res = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    write_baseline(bl, res.findings, {}, "accepted for the fixture")
+    # Fix a.py, then scan ONLY b.py: the now-stale entry for a.py is
+    # out of scope and must not fail the partial run.
+    (pkg / "a.py").write_text("def f(x):\n    return x\n")
+    sub = run_lint(
+        ["no-print"], roots=roots, baseline_path=bl, paths=[pkg / "b.py"]
+    )
+    assert sub.findings == [] and sub.stale_baseline == []
+    # The full scan still catches it — staleness is a full-suite truth.
+    full = run_lint(["no-print"], roots=roots, baseline_path=bl)
+    assert len(full.stale_baseline) == 1
+
+
+def test_cli_changed_rejects_update_baseline():
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    rc = main([
+        "lint", "--changed", "--update-baseline", "--reason", "nope",
+    ])
+    assert rc == 2
+
+
+def test_cli_changed_json_is_json_even_with_no_changes(
+    monkeypatch, capsys
+):
+    """--json promises one parseable document on stdout; an empty
+    change set must not degrade it to a prose line."""
+    import json
+
+    from dss_ml_at_scale_tpu.config import commands
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    monkeypatch.setattr(
+        commands, "_changed_python_files", lambda ref: []
+    )
+    assert main(["lint", "--changed", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"]["active"] == 0
+
+
+def test_cli_changed_runs_against_the_repo():
+    """`dsst lint --changed` on the real checkout: whatever is dirty vs
+    HEAD must be lint-clean (the full-suite gate already guarantees the
+    superset, so this is about the plumbing: git scoping, root
+    attribution, full-scan-only skipping)."""
+    import subprocess
+
+    from dss_ml_at_scale_tpu.analysis.core import REPO_ROOT
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    probe = subprocess.run(
+        ["git", "rev-parse", "HEAD"], cwd=REPO_ROOT,
+        capture_output=True, text=True,
+    )
+    if probe.returncode != 0:
+        pytest.skip("not a git checkout")
+    assert main(["lint", "--changed"]) == 0

@@ -20,6 +20,17 @@ def test_run_store_roundtrip(tmp_path):
     assert meta["run_name"] == "my-run"
 
 
+def test_log_metrics_after_finish_is_a_noop(tmp_path):
+    """A fit thread logging while shutdown races finish() must drop the
+    lines, not die on a closed metrics handle — the write path checks
+    _closed under the same lock finish() flips it under."""
+    store = RunStore(tmp_path, "exp1", run_name="late-logger")
+    store.log_metrics({"loss": 2.5}, step=1)
+    store.finish()
+    store.log_metrics({"loss": 1.5}, step=2)  # must not raise
+    assert [m["step"] for m in store.metrics()] == [1]
+
+
 def test_start_run_context_marks_failed(tmp_path):
     with pytest.raises(RuntimeError):
         with start_run(tmp_path, "exp") as run:

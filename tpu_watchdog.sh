@@ -22,6 +22,23 @@ if [ "$DEADLINE_EPOCH" -le "$(date -u +%s)" ]; then
   DEADLINE_EPOCH="$(date -u -d "tomorrow $DEADLINE" +%s)"
 fi
 echo "$(date -u +%H:%M:%S) deadline armed: $DEADLINE utc (epoch $DEADLINE_EPOCH)" >> tpu_watchdog.log
+# Preflight both analysis tiers BEFORE entering the probe loop: if the
+# AST lint or the IR audit is dirty, the chain must not fire at all — a
+# claim spent compiling a program whose train step lost its donation or
+# grew a surprise all-gather is a claim wasted. Pinned to cpu so the
+# preflight can never touch (or hang on) the tunnel; the audit
+# multiplexes its own 8-device abstract mesh.
+if ! JAX_PLATFORMS=cpu timeout 600 python -m dss_ml_at_scale_tpu.config.cli \
+    lint >> tpu_watchdog.log 2>&1; then
+  echo "$(date -u +%H:%M:%S) preflight FAILED: dsst lint dirty - watchdog refusing to arm" >> tpu_watchdog.log
+  exit 1
+fi
+if ! JAX_PLATFORMS=cpu timeout 900 python -m dss_ml_at_scale_tpu.config.cli \
+    audit >> tpu_watchdog.log 2>&1; then
+  echo "$(date -u +%H:%M:%S) preflight FAILED: dsst audit dirty - watchdog refusing to arm" >> tpu_watchdog.log
+  exit 1
+fi
+echo "$(date -u +%H:%M:%S) preflight clean: lint + audit" >> tpu_watchdog.log
 N=0
 while true; do
   if [ "$(date -u +%s)" -ge "$DEADLINE_EPOCH" ]; then
