@@ -1,7 +1,7 @@
 """Checker plugins. Importing this package registers every rule.
 
 Three migrated from the ad-hoc ``scripts/check_*.py`` lints (thin shims
-remain at the old paths), five new JAX/runtime-aware rules.
+remain at the old paths), seven new JAX/runtime-aware rules.
 """
 
 from . import (  # noqa: F401
@@ -12,6 +12,7 @@ from . import (  # noqa: F401
     lock_discipline,
     no_print,
     retrace_hazard,
+    span_discipline,
     telemetry_registry,
     trace_safety,
 )

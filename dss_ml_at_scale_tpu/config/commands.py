@@ -1749,6 +1749,12 @@ def register_serve(sub: argparse._SubParsersAction) -> None:
         help="JPEG decode threads feeding the batcher (host-side work, "
         "off the scoring thread)",
     )
+    sv.add_argument(
+        "--access-log", default=None, metavar="JSONL",
+        help="structured request log: one JSON line per /predict "
+        "(request_id matching the X-DSST-Trace response header, "
+        "status, queue_ms, batch_fill)",
+    )
     sv.set_defaults(fn=_cmd_serve)
 
 
@@ -1784,7 +1790,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # The accept loop runs in the handle's thread so Ctrl-C lands here,
     # where close() can drain WHILE the server still answers (/readyz
     # flips 503, queued work finishes, in-flight responses complete).
-    handle = serve_in_thread(predictor, args.host, args.port, config=config)
+    handle = serve_in_thread(predictor, args.host, args.port, config=config,
+                             access_log=args.access_log)
     print(json.dumps({
         "serving": handle.address,
         "model": predictor.meta.get("model"),
@@ -2024,6 +2031,15 @@ def _cmd_runs_doctor(args: argparse.Namespace) -> int:
                 line += (
                     f" — resumable: step {cls['resumable_step']} in "
                     f"{cls['checkpoint_dir']}"
+                )
+            if (
+                cls["effective_status"] == "INTERRUPTED"
+                and cls.get("trace_file")
+                and Path(cls["trace_file"]).exists()
+            ):
+                line += (
+                    f" — flight recorder: {cls['trace_file']} "
+                    "(dsst trace tail)"
                 )
             print(line)
         n_marked = sum(1 for c in report if c.get("marked"))
@@ -2572,6 +2588,276 @@ def _cmd_audit(args: argparse.Namespace) -> int:
         return 2
 
 
+def register_trace(sub: argparse._SubParsersAction) -> None:
+    tr = sub.add_parser(
+        "trace",
+        help="causal tracing tools over a run's flight-recorder tail "
+        "(or any span JSONL): tail reconstructs a dead run's last "
+        "events including spans still open at the kill, export writes "
+        "a Perfetto trace with cross-thread flow arrows per trace id, "
+        "attribution breaks each training step into "
+        "data-wait/transfer/compute/host and flags step-time anomalies "
+        "with their causal children",
+    )
+    tsub = tr.add_subparsers(dest="trace_cmd", required=True)
+
+    def _add_source(p):
+        p.add_argument(
+            "--run", default=None, metavar="DIR",
+            help="run directory (<root>/<experiment>/<run_id>): reads "
+            "the flight-recorder tail its journal registered "
+            "(flightrec.jsonl)",
+        )
+        p.add_argument(
+            "--file", default=None, metavar="JSONL",
+            help="explicit flight-recorder tail or span JSONL "
+            "(overrides --run)",
+        )
+
+    tl = tsub.add_parser(
+        "tail",
+        help="the last events of a (possibly SIGKILLed) run; "
+        "begin-only spans are flagged OPEN — the in-flight work at "
+        "the kill",
+    )
+    _add_source(tl)
+    tl.add_argument("-n", "--events", type=int, default=32,
+                    help="how many trailing events to show")
+    tl.add_argument("--json", action="store_true",
+                    help="one JSON object per line instead of the table")
+    tl.set_defaults(fn=_cmd_trace_tail)
+
+    ex = tsub.add_parser(
+        "export",
+        help="Perfetto trace_event JSON: labeled process/thread lanes "
+        "(ph M) and flow arrows (ph s/f) stitching each trace id "
+        "across threads; loads in ui.perfetto.dev",
+    )
+    _add_source(ex)
+    ex.add_argument("--out", required=True, metavar="OUT",
+                    help="output trace file")
+    ex.set_defaults(fn=_cmd_trace_export)
+
+    at = tsub.add_parser(
+        "attribution",
+        help="per-step breakdown (data-wait / transfer / compute / "
+        "host) from the step traces, plus z-score step-time anomalies "
+        "with the anomalous step's causal children",
+    )
+    _add_source(at)
+    at.add_argument("--zscore", type=float, default=3.0,
+                    help="|z| threshold flagging a step-time anomaly")
+    at.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON document")
+    at.set_defaults(fn=_cmd_trace_attribution)
+
+
+def _trace_source(args: argparse.Namespace) -> Path | None:
+    """Resolve tail|export|attribution's input file; None + message on
+    failure (callers exit 2)."""
+    if args.file:
+        p = Path(args.file)
+        if not p.exists():
+            print(f"no trace file at {p}")
+            return None
+        return p
+    if args.run:
+        from ..tracking import classify_run
+
+        cls = classify_run(args.run)
+        candidates = [
+            Path(cls["trace_file"]) if cls.get("trace_file") else None,
+            Path(args.run) / "flightrec.jsonl",
+        ]
+        for p in candidates:
+            if p is not None and p.exists():
+                return p
+        print(f"no flight-recorder tail under {args.run} (was the run "
+              "started by a trace-aware dsst?)")
+        return None
+    print("pass --run DIR or --file JSONL")
+    return None
+
+
+def _cmd_trace_tail(args: argparse.Namespace) -> int:
+    from ..telemetry import flightrec
+
+    path = _trace_source(args)
+    if path is None:
+        return 2
+    events = flightrec.read_events(path)
+    if not events:
+        print(f"no parseable events in {path}")
+        return 1
+    complete, opens = flightrec.reconstruct(events)
+    # Trailing window: the last N closed spans, then EVERY open span —
+    # the open ones are the point (in-flight work at the kill). The
+    # window can be zero (opens alone fill -n); list[-0:] would be the
+    # WHOLE list, so slice from an explicit start index.
+    n_closed = max(args.events - len(opens), 0)
+    rows = complete[len(complete) - min(n_closed, len(complete)):] \
+        if n_closed else []
+    rows = rows + [{**o, "open": True} for o in opens]
+    if args.json:
+        for r in rows:
+            print(json.dumps(r))
+        return 0
+    print(f"{path}: {len(complete)} closed span(s), {len(opens)} open")
+    for r in rows:
+        ts = time.strftime("%H:%M:%S", time.localtime(r.get("ts", 0.0)))
+        dur = "OPEN" if r.get("open") else f"{r.get('dur', 0.0)*1e3:9.2f}ms"
+        trace = r.get("trace", "-")
+        kindtag = f"[{r['kind']}]" if r.get("kind") else ""
+        argstr = ""
+        if r.get("args"):
+            argstr = " " + ",".join(
+                f"{k}={v}" for k, v in r["args"].items() if k != "open"
+            )
+        print(f"{ts} {r.get('thread', '?'):<22} {r.get('name', '?'):<20} "
+              f"{dur:>12} trace={trace} {kindtag}{argstr}")
+    if opens:
+        print(f"{len(opens)} span(s) were OPEN when recording stopped "
+              "(in-flight at the kill)")
+    return 0
+
+
+def _cmd_trace_export(args: argparse.Namespace) -> int:
+    from ..telemetry.spans import load_span_jsonl, to_perfetto
+
+    path = _trace_source(args)
+    if path is None:
+        return 2
+    events = load_span_jsonl(path)
+    if not events:
+        print(f"no parseable events in {path}")
+        return 1
+    # Build in memory, count from the dict, write once — re-reading the
+    # file just written (possibly tens of MB) to count flows is waste.
+    trace = to_perfetto(events)
+    flows = sum(
+        1 for e in trace["traceEvents"] if e.get("ph") in ("s", "f")
+    )
+    out_path = Path(args.out)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+    print(f"perfetto trace: {len(events)} span(s), {flows} flow "
+          f"event(s) -> {args.out}")
+    return 0
+
+
+# Attribution buckets: span name -> where a step's wall time went. The
+# names are held to telemetry.catalog.KNOWN_SPANS by the span-discipline
+# lint, so this mapping cannot silently rot.
+_ATTRIBUTION = {
+    "reader.next": "data_wait",
+    "feeder.place": "transfer",
+    "mesh.plan": "transfer",
+    "train_step": "compute",
+}
+
+
+def _cmd_trace_attribution(args: argparse.Namespace) -> int:
+    from ..telemetry import flightrec
+
+    path = _trace_source(args)
+    if path is None:
+        return 2
+    complete, opens = flightrec.reconstruct(flightrec.read_events(path))
+    by_trace: dict[str, list[dict]] = {}
+    for e in complete:
+        if e.get("kind") == "step" and e.get("trace"):
+            by_trace.setdefault(e["trace"], []).append(e)
+    steps = []
+    for trace_id, spans in by_trace.items():
+        compute = [s for s in spans if s["name"] == "train_step"]
+        if not compute:
+            continue  # eval/warmup batches: staged but never stepped
+        buckets = {"data_wait": 0.0, "transfer": 0.0, "compute": 0.0,
+                   "host": 0.0}
+        for s in spans:
+            buckets[_ATTRIBUTION.get(s["name"], "host")] += s.get(
+                "dur", 0.0
+            )
+        steps.append({
+            "step": (compute[0].get("args") or {}).get("step"),
+            "trace": trace_id,
+            "ts": compute[0].get("ts", 0.0),
+            **{k: round(v * 1e3, 3) for k, v in buckets.items()},
+            "total": round(sum(buckets.values()) * 1e3, 3),
+            "spans": [
+                {"name": s["name"], "thread": s.get("thread"),
+                 "dur_ms": round(s.get("dur", 0.0) * 1e3, 3)}
+                for s in sorted(spans, key=lambda s: s.get("ts", 0.0))
+            ],
+        })
+    if not steps:
+        print(f"no step traces in {path} (is this a training run's "
+              "flight recorder?)")
+        return 1
+    steps.sort(key=lambda s: s["ts"])
+    # Anomalies are flagged on TOTAL traced step time: a data-wait or
+    # transfer spike IS a step-time anomaly (the feeder-stall case this
+    # tool exists to surface) even when compute stays nominal.
+    durs = [s["total"] for s in steps]
+    mean = sum(durs) / len(durs)
+    var = sum((d - mean) ** 2 for d in durs) / len(durs)
+    std = var ** 0.5
+    anomalies = []
+    for s in steps:
+        z = (s["total"] - mean) / std if std > 0 else 0.0
+        s["z"] = round(z, 2)
+        if abs(z) >= args.zscore:
+            anomalies.append(s)
+    report = {
+        "file": str(path),
+        "steps": len(steps),
+        "total_ms_mean": round(mean, 3),
+        "total_ms_std": round(std, 3),
+        "compute_ms_mean": round(
+            sum(s["compute"] for s in steps) / len(steps), 3
+        ),
+        "data_wait_ms_mean": round(
+            sum(s["data_wait"] for s in steps) / len(steps), 3
+        ),
+        "transfer_ms_mean": round(
+            sum(s["transfer"] for s in steps) / len(steps), 3
+        ),
+        "host_ms_mean": round(
+            sum(s["host"] for s in steps) / len(steps), 3
+        ),
+        "zscore_threshold": args.zscore,
+        "anomalies": anomalies,
+        "open_spans": [o.get("name") for o in opens],
+    }
+    if args.json:
+        report["per_step"] = [
+            {k: v for k, v in s.items() if k != "spans"} for s in steps
+        ]
+        print(json.dumps(report))
+        return 0
+    print(f"{len(steps)} step(s): total {mean:.3f}ms ± {std:.3f}ms, "
+          f"compute {report['compute_ms_mean']}ms, "
+          f"data-wait {report['data_wait_ms_mean']}ms, "
+          f"transfer {report['transfer_ms_mean']}ms, "
+          f"host {report['host_ms_mean']}ms (means per step)")
+    hdr = (f"{'STEP':>6} {'DATA':>9} {'XFER':>9} {'COMPUTE':>9} "
+           f"{'HOST':>9} {'TOTAL':>9} {'Z':>6}")
+    print(hdr)
+    for s in steps:
+        print(f"{str(s['step']):>6} {s['data_wait']:>9.3f} "
+              f"{s['transfer']:>9.3f} {s['compute']:>9.3f} "
+              f"{s['host']:>9.3f} {s['total']:>9.3f} {s['z']:>6.2f}")
+    for a in anomalies:
+        print(f"anomaly: step {a['step']} (z={a['z']}) — causal children:")
+        for s in a["spans"]:
+            print(f"    {s['name']:<20} {s['dur_ms']:>9.3f}ms "
+                  f"on {s['thread']}")
+    if not anomalies:
+        print(f"no |z| >= {args.zscore:g} step-time anomalies")
+    return 0
+
+
 def register_all(sub: argparse._SubParsersAction) -> None:
     register_datagen(sub)
     register_forecast(sub)
@@ -2589,6 +2875,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_runs(sub)
     register_chaos(sub)
     register_telemetry(sub)
+    register_trace(sub)
     register_lint(sub)
     register_audit(sub)
     from .pipeline import register_pipeline

@@ -43,6 +43,7 @@ from jax.sharding import Mesh
 from .. import telemetry
 from ..resilience.rollback import PROVENANCE_KEY
 from ..runtime.mesh import get_batch_placer
+from ..telemetry import tracecontext
 
 _SENTINEL = object()
 
@@ -105,6 +106,7 @@ class Feeder:
         self._queue: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._done = False
+        self._last_handoff = tracecontext.Handoff(None)
         # Bound on the instance so close() still works from a generator
         # finalizer during interpreter shutdown (module globals may be
         # torn down by then — same discipline as the reader pool).
@@ -150,14 +152,23 @@ class Feeder:
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
-                raw = next(self._source, _SENTINEL)
-                if raw is _SENTINEL:
-                    break
-                t0 = time.perf_counter()
-                batch, prov = split_provenance(raw)
-                device_batch = self._place(batch)
-                self._stage_hist.observe(time.perf_counter() - t0)
-                if not self._put((device_batch, prov)):
+                # One step trace per batch, born HERE: the feeder is the
+                # first thread to touch a step's data, so the step_id
+                # covers reader pull → staging/sharding → (via the
+                # handoff riding the queue) the consumer's step dispatch.
+                with tracecontext.trace(kind="step") as tctx:
+                    with telemetry.span("reader.next", feeder=self.name):
+                        raw = next(self._source, _SENTINEL)
+                    if raw is _SENTINEL:
+                        break
+                    t0 = time.perf_counter()
+                    batch, prov = split_provenance(raw)
+                    with telemetry.span("feeder.place", feeder=self.name):
+                        device_batch = self._place(batch)
+                    self._stage_hist.observe(time.perf_counter() - t0)
+                if not self._put(
+                    ((device_batch, prov), tracecontext.Handoff(tctx))
+                ):
                     return  # closed while blocked on a full queue
                 self._batches_total.inc()
         except BaseException as e:
@@ -180,6 +191,15 @@ class Feeder:
     def occupancy(self) -> int:
         """On-device batches currently queued (approximate, lock-free)."""
         return self._queue.qsize()
+
+    @property
+    def last_handoff(self) -> tracecontext.Handoff:
+        """The step-trace handoff of the batch the last ``next()``
+        returned — the consumer activates it around its step dispatch so
+        the step's spans join the batch's causal timeline. Read it
+        before the next ``next()`` (single-consumer, like the iterator
+        itself)."""
+        return self._last_handoff
 
     def __iter__(self) -> Iterator[tuple[Any, Any]]:
         return self
@@ -212,7 +232,8 @@ class Feeder:
             self._done = True
             self._thread.join(timeout=5)
             raise item.error
-        return item
+        pair, self._last_handoff = item
+        return pair
 
     def close(self) -> None:
         """Stop the feeder thread and join it. Idempotent; safe to call

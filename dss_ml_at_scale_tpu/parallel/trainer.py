@@ -22,6 +22,7 @@ Semantics carried over from the reference driver:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 import logging
@@ -39,6 +40,7 @@ from flax.core import FrozenDict
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry
+from ..telemetry import tracecontext
 from ..data.prefetch import MeshFeeder, split_provenance
 from ..resilience import checkpoint as integrity
 from ..resilience import durability
@@ -700,6 +702,18 @@ class Trainer:
             ).observe,
         )
 
+        # The run's root span: a "fit" begin event hits the flight
+        # recorder before the first step, so ANY kill from here on
+        # leaves at least one open span naming the run that died.
+        # ExitStack (not a with-block) keeps the 200-line loop body at
+        # its current indentation; closed FIRST in the finally so the
+        # span closes even on a health abort.
+        trace_scope = contextlib.ExitStack()
+        trace_scope.enter_context(tracecontext.trace(kind="run"))
+        trace_scope.enter_context(
+            telemetry.span("fit", max_epochs=cfg.max_epochs)
+        )
+        step_handoff = tracecontext.Handoff(None)
         try:
             with guard:
                 for epoch in range(start_epoch, cfg.max_epochs):
@@ -739,23 +753,33 @@ class Trainer:
                             jax.profiler.start_trace(cfg.profile_dir)
                             tracing = True
                             trace_stop_at = step + cfg.profile_num_steps
-                        if supervisor is None:
-                            state, metrics = train_step(state, batch)
-                            action = "commit"
-                        else:
-                            inject = supervisor.next_injection()
-                            (state, hstate), step_metrics = train_step(
-                                (state, hstate), batch, inject
-                            )
-                            # One scalar fetch: the verdict (and on a bad
-                            # step, the loss/z diagnostics). This is the
-                            # supervised loop's per-step metrics fetch; the
-                            # discard already happened on device.
-                            action = supervisor.observe(
-                                step + 1, step_metrics, prov
-                            )
-                            if action == "commit":
-                                metrics = step_metrics
+                        # The step runs under the batch's OWN trace (born
+                        # on the feeder thread): reader pull, staging,
+                        # and this dispatch share one step_id, and the
+                        # begin event makes a kill mid-step leave an
+                        # open train_step span in the flight recorder.
+                        step_handoff = feeder.last_handoff
+                        with step_handoff.activate(), telemetry.span(
+                            "train_step", step=step
+                        ):
+                            if supervisor is None:
+                                state, metrics = train_step(state, batch)
+                                action = "commit"
+                            else:
+                                inject = supervisor.next_injection()
+                                (state, hstate), step_metrics = train_step(
+                                    (state, hstate), batch, inject
+                                )
+                                # One scalar fetch: the verdict (and on a
+                                # bad step, the loss/z diagnostics). This
+                                # is the supervised loop's per-step
+                                # metrics fetch; the discard already
+                                # happened on device.
+                                action = supervisor.observe(
+                                    step + 1, step_metrics, prov
+                                )
+                                if action == "commit":
+                                    metrics = step_metrics
                         if action == "commit":
                             epoch_steps += 1
                             step += 1  # host-side mirror: no device sync
@@ -836,6 +860,7 @@ class Trainer:
                                 metric_val=None,
                                 use_best=False,
                                 synchronous=True,
+                                trace=step_handoff,
                             )
                         log.warning(
                             "preempted at step %d (epoch %d); resumable "
@@ -848,6 +873,7 @@ class Trainer:
                         break
                     jax.block_until_ready(state.params)
                     dt = time.perf_counter() - t0
+                    # dsst: ignore[span-discipline] args (step count) are only known at close; a raw record keeps the exact legacy start/duration semantics
                     telemetry.get_span_log().record(
                         "train_epoch", t0_wall, dt, epoch=epoch, steps=epoch_steps
                     )
@@ -892,6 +918,7 @@ class Trainer:
                             manager, cfg, state, step,
                             metric_val=metric_val,
                             use_best=val_data_factory is not None,
+                            trace=step_handoff,
                         )
         finally:
             # Teardown runs on EVERY exit, including a health abort
@@ -903,6 +930,7 @@ class Trainer:
             # save + manifest finalizer joined, or the process continues
             # with a truncated trace and a checkpoint whose manifest
             # never lands.
+            trace_scope.close()
             feeder.close()
             if tracing:
                 jax.block_until_ready(state.params)
@@ -1029,7 +1057,8 @@ class Trainer:
 
     def _save(self, manager, cfg: TrainerConfig, state: TrainState,
               step: int, *, metric_val, use_best: bool,
-              synchronous: bool = False) -> None:
+              synchronous: bool = False,
+              trace: tracecontext.Handoff | None = None) -> None:
         """One checkpoint step + its integrity manifest.
 
         The manifest must checksum the COMMITTED files, which means
@@ -1060,7 +1089,11 @@ class Trainer:
         # this save (orbax's async internals aren't documented
         # thread-safe). By now it is long done — an epoch has passed.
         self._join_manifest_writer()
-        with telemetry.span("checkpoint", step=step):
+        # The save runs under the committing step's trace (the feeder's
+        # step_id): checkpoint dispatch, the async finalizer below, and
+        # the train step that produced the weights share one timeline.
+        handoff = trace if trace is not None else tracecontext.Handoff(None)
+        with handoff.activate(), telemetry.span("checkpoint", step=step):
             maybe_fail("checkpoint.save")
             manager.save(
                 step,
@@ -1069,27 +1102,35 @@ class Trainer:
             )
 
         def finalize() -> None:
-            try:
-                manager.wait_until_finished()
-                # Process 0 only — the manifest is one file per step,
-                # not per host.
-                if self.topology.process_index == 0:
-                    step_dir = Path(str(manager.directory)) / str(step)
-                    if step_dir.is_dir():
-                        integrity.write_manifest(step_dir)
-                        # Manifest landed => the step is durably
-                        # committed: record it in the run journal so a
-                        # fresh process (doctor, --resume-auto, the
-                        # arbiter) knows the last committed step without
-                        # walking the checkpoint dir.
-                        self._journal(
-                            "checkpoint", step=step,
-                            checkpoint_dir=str(manager.directory),
-                        )
-            except Exception:
-                # A failed manifest leaves the step "unverified" (still
-                # restorable), never a crashed training run.
-                log.exception("manifest write failed for step %d", step)
+            # The finalizer thread adopts the step's handoff: its begin
+            # event means a SIGKILL inside the save window leaves an
+            # open checkpoint.finalize span naming the torn step.
+            with handoff.activate(), telemetry.span(
+                "checkpoint.finalize", step=step
+            ):
+                try:
+                    manager.wait_until_finished()
+                    # Process 0 only — the manifest is one file per
+                    # step, not per host.
+                    if self.topology.process_index == 0:
+                        step_dir = Path(str(manager.directory)) / str(step)
+                        if step_dir.is_dir():
+                            integrity.write_manifest(step_dir)
+                            # Manifest landed => the step is durably
+                            # committed: record it in the run journal so
+                            # a fresh process (doctor, --resume-auto,
+                            # the arbiter) knows the last committed step
+                            # without walking the checkpoint dir.
+                            self._journal(
+                                "checkpoint", step=step,
+                                checkpoint_dir=str(manager.directory),
+                            )
+                except Exception:
+                    # A failed manifest leaves the step "unverified"
+                    # (still restorable), never a crashed training run.
+                    log.exception(
+                        "manifest write failed for step %d", step
+                    )
 
         if synchronous:
             finalize()

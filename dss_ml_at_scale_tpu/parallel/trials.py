@@ -27,6 +27,7 @@ import jax
 
 from .. import telemetry
 from ..hpo.fmin import Trials, _call_objective, _log_trial
+from ..telemetry import tracecontext
 
 log = logging.getLogger(__name__)
 
@@ -90,13 +91,27 @@ def _run_async_pool(
         "hpo_trials_total", "completed HPO trials by outcome",
         labels=("status",),
     )
+
+    def _traced(handoff: tracecontext.Handoff, tid: int, point: dict):
+        # Worker-pool boundary: the trial's trace was minted on the
+        # driver thread at proposal time; the pool thread adopts it so
+        # the trial span joins the same timeline as trial.submit.
+        with handoff.activate():
+            return evaluate(tid, point)
+
     submitted = len(trials.trials)
     with ThreadPoolExecutor(max_workers=parallelism) as pool:
         pending = set()
         while submitted < max_evals or pending:
             while submitted < max_evals and len(pending) < parallelism:
-                point = algo(space, trials._history(), rng)
-                pending.add(pool.submit(evaluate, submitted, point))
+                handoff = tracecontext.Handoff.root(kind="trial")
+                with handoff.activate(), telemetry.span(
+                    "trial.submit", tid=submitted
+                ):
+                    point = algo(space, trials._history(), rng)
+                pending.add(
+                    pool.submit(_traced, handoff, submitted, point)
+                )
                 submitted += 1
             done, pending = wait(pending, return_when=FIRST_COMPLETED)
             for fut in done:

@@ -338,6 +338,7 @@ def _train_invariants(cfg: ChaosConfig, workdir: Path, ckpt: Path,
     inv["no_stranded_tmp"] = _stranded_tmp_check(workdir)
     inv["commit_log_sane"] = _commit_log_check(root, read_journal)
     inv["params_bitwise_equal"] = _parity_check(ckpt, ref_ckpt)
+    inv["flight_recorder_tail"] = _flight_recorder_check(root)
     return inv
 
 
@@ -424,6 +425,55 @@ def _commit_log_check(root: Path, read_journal) -> dict:
         "problems": problems,
         "committed_steps": sorted(committed_ever),
         "recommitted_after_rollback": sorted(set(recommitted)),
+    }
+
+
+def _flight_recorder_check(root: Path) -> dict:
+    """Flight-recorder invariant: every SIGKILLed run's trace tail must
+    parse (torn last line tolerated by construction), and at least one
+    killed run must have left an OPEN (begin-only) span from the fit
+    hierarchy — the in-flight work at the kill, which only a
+    begin-at-open recorder can preserve. The `fit` root span is open
+    for the whole run, so any kill after startup satisfies this; a kill
+    mid-step additionally leaves the open `train_step` span the
+    acceptance asks for."""
+    from ..tracking import classify_run
+    from ..telemetry import flightrec
+
+    fit_family = {"fit", "train_epoch", "train_step", "checkpoint",
+                  "checkpoint.finalize"}
+    runs_checked = 0
+    unparseable: list[str] = []
+    open_names: list[list[str]] = []
+    exp = root / "chaos"
+    run_dirs = sorted(
+        p for p in exp.iterdir() if p.is_dir()
+    ) if exp.is_dir() else []
+    for run_dir in run_dirs:
+        cls = classify_run(run_dir)
+        if cls["effective_status"] != "INTERRUPTED":
+            continue  # finished runs close every span; nothing to prove
+        trace_file = cls.get("trace_file")
+        if not trace_file or not Path(trace_file).exists():
+            continue  # killed before the recorder enabled: no tail owed
+        runs_checked += 1
+        events = flightrec.read_events(trace_file)
+        if not events:
+            unparseable.append(str(trace_file))
+            continue
+        _complete, opens = flightrec.reconstruct(events)
+        open_names.append(sorted({o.get("name", "?") for o in opens}))
+    any_inflight = any(
+        set(names) & fit_family for names in open_names
+    )
+    return {
+        # A soak whose kills all landed pre-recorder has proven nothing:
+        # require at least one interrupted run WITH a tail, that every
+        # tail parses, and that in-flight fit-family work survived.
+        "ok": runs_checked > 0 and not unparseable and any_inflight,
+        "interrupted_runs_with_tail": runs_checked,
+        "unparseable": unparseable,
+        "open_spans_per_run": open_names,
     }
 
 

@@ -163,7 +163,8 @@ def append_jsonl(path: str | os.PathLike, objs: Iterable[dict], *,
     Appends are crash-safe by construction when readers tolerate a torn
     last line (the journal and quarantine readers do); ``fsync=True``
     additionally guarantees the lines survive power loss before the
-    caller acts on them. Returns the number of lines written.
+    caller acts on them. Returns the number of bytes appended (the
+    flight recorder's rotation accounting — serialized once, here).
     """
     path = Path(path)
     lines = [json.dumps(o) for o in objs]
@@ -194,7 +195,7 @@ def append_jsonl(path: str | os.PathLike, objs: Iterable[dict], *,
         f.flush()
         if fsync:
             _fsync_fd(f.fileno(), kind)
-    return len(lines)
+    return len(payload)
 
 
 def find_stranded_tmp(root: str | os.PathLike, *,

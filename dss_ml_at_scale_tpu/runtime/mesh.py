@@ -216,6 +216,8 @@ class MeshBatchPlacer:
         with self._lock:
             shardings = self._plans.get(key)
             if shardings is None:
+                from .. import telemetry
+
                 # Plan construction happens UNDER the lock: it walks and
                 # mutates the _shardings memo, and this instance is
                 # documented thread-safe (feeder thread + training
@@ -224,7 +226,11 @@ class MeshBatchPlacer:
                 # lint). Construction is cheap host work (validation +
                 # NamedSharding objects) and runs once per distinct
                 # batch structure; nothing is cached when it raises.
-                shardings = [self._leaf_sharding(p, x) for p, x in flat]
+                # The span makes plan churn visible on a trace timeline:
+                # a plan per batch means a shape leak upstream (the
+                # retrace-hazard of the input pipeline).
+                with telemetry.span("mesh.plan", leaves=len(flat)):
+                    shardings = [self._leaf_sharding(p, x) for p, x in flat]
                 if len(self._plans) >= self._MAX_PLANS:
                     self._plans.pop(next(iter(self._plans)))
                 self._plans[key] = shardings

@@ -76,7 +76,7 @@ class Request:
     """
 
     __slots__ = ("n", "deadline", "t_admit", "results", "error",
-                 "_remaining", "_done", "_lock")
+                 "trace", "batch_fill", "_remaining", "_done", "_lock")
 
     # Lint contract (dsst lint, lock-discipline rule): settlement state
     # is written by whichever worker thread ends the request — always
@@ -90,6 +90,15 @@ class Request:
         self.t_admit = time.monotonic()
         self.results: list = [None] * n
         self.error: BaseException | None = None
+        # Causal identity, attached by the scheduler: the submitting
+        # thread's trace handoff (workers adopt it around decode/score
+        # spans). This module stays telemetry-free — it only carries
+        # the object.
+        self.trace = None
+        # Fill of the micro-batch this request last scored in (written
+        # by the batcher thread before completion, read by the handler
+        # after settlement — the _done event publishes the write).
+        self.batch_fill: int | None = None
         self._remaining = n
         self._done = threading.Event()
         self._lock = threading.Lock()

@@ -21,9 +21,14 @@ can drive it with plain lists.
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
+
+
+def _NULL_TRACE(job):
+    return contextlib.nullcontext()
 
 
 class DecodePool:
@@ -44,7 +49,7 @@ class DecodePool:
 
     def __init__(self, *, decode, in_q: queue.Queue, out_q: queue.Queue,
                  on_skip, on_error, stop: threading.Event,
-                 workers: int = 2, poll_s: float = 0.05):
+                 workers: int = 2, poll_s: float = 0.05, trace=None):
         if workers < 1:
             raise ValueError(f"decode workers must be >= 1, got {workers}")
         self._decode = decode
@@ -54,6 +59,11 @@ class DecodePool:
         self._on_error = on_error
         self._stop = stop
         self._poll_s = poll_s
+        # Optional tracing hook: a callable(job) returning a context
+        # manager the decode runs inside (the scheduler injects the
+        # request's trace handoff + span there — this module stays
+        # policy- and telemetry-free).
+        self._trace = trace if trace is not None else _NULL_TRACE
         self._threads = [
             threading.Thread(
                 target=self._run, name=f"dsst-serve-decode-{i}", daemon=True
@@ -82,7 +92,8 @@ class DecodePool:
                     self._on_skip(item)
                 continue
             try:
-                images = self._decode([item.payload for item in job])
+                with self._trace(job):
+                    images = self._decode([item.payload for item in job])
             except Exception as exc:
                 self._on_error(job, exc)
                 continue

@@ -35,12 +35,14 @@ batches score through ``predict``.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import queue
 import threading
 import time
 
 from .. import telemetry
+from ..telemetry import tracecontext
 from .admission import (
     AdmissionController,
     DeadlineExceeded,
@@ -144,6 +146,7 @@ class ServingScheduler:
             on_error=self._fail_job,
             stop=self._stop,
             workers=self.config.decode_workers,
+            trace=self._decode_trace,
         )
         self._batcher = Batcher(
             in_q=self._batch_q,
@@ -202,12 +205,17 @@ class ServingScheduler:
 
     # -- the client-facing call -------------------------------------------
 
-    def submit(self, payloads: list) -> list:
+    def submit(self, payloads: list, info: dict | None = None) -> list:
         """Score ``payloads`` through the shared batch pipeline.
 
         Blocks the calling (HTTP handler) thread until its request
         settles; raises the scheduler refusal or the pipeline's own
         error, exactly as the synchronous path would have.
+
+        ``info`` (optional dict) is populated with per-request
+        accounting on the way out — ``queue_ms`` (admission to
+        settlement) and ``batch_fill`` (size of the micro-batch the
+        request scored in) — the structured-access-log side channel.
         """
         if not payloads:
             raise ValueError("empty batch")
@@ -236,6 +244,10 @@ class ServingScheduler:
             if cfg.deadline_ms > 0 else None
         )
         req = Request(len(payloads), deadline)
+        # The submitting thread's trace rides the request: the decode
+        # pool and batcher adopt it around their spans, so one
+        # request_id follows admission → decode → score across threads.
+        req.trace = tracecontext.Handoff.capture()
         # One decode job per request (vectorized decode); the pool
         # fans the decoded items out per image for the batcher.
         self._decode_q.put(
@@ -255,11 +267,26 @@ class ServingScheduler:
             if self._stop.is_set():
                 req.fail(NotAccepting("serving stopped"))
                 break
+        if info is not None:
+            info["queue_ms"] = round(
+                (time.monotonic() - req.t_admit) * 1000.0, 3
+            )
+            info["batch_fill"] = req.batch_fill
         if req.error is not None:
             raise req.error
         return list(req.results)
 
     # -- worker callbacks --------------------------------------------------
+
+    @contextlib.contextmanager
+    def _decode_trace(self, job: list):
+        """Decode-pool hook: the decode runs under the owning request's
+        trace, as a ``serve.decode`` span on the worker thread."""
+        handoff = job[0].request.trace or tracecontext.Handoff(None)
+        with handoff.activate(), telemetry.span(
+            "serve.decode", images=len(job)
+        ):
+            yield
 
     def _expire(self, req: Request) -> None:
         if req.fail(DeadlineExceeded(
@@ -288,6 +315,7 @@ class ServingScheduler:
         now = time.monotonic()
         for item in items:
             self._time_in_queue.observe(now - item.request.t_admit)
+        t0_wall = time.time()
         t0 = time.perf_counter()
         try:
             rows = self._score_items(items)
@@ -298,11 +326,31 @@ class ServingScheduler:
                 item.request.fail(exc)
                 self._retire(item)
             return
-        self._admission.note_service_rate(
-            (time.perf_counter() - t0) / len(items)
-        )
+        score_dur = time.perf_counter() - t0
+        self._admission.note_service_rate(score_dur / len(items))
         self._batch_fill.observe(len(items))
         self._batches.inc()
+        # One coalesced batch serves many requests; each traced request
+        # gets its OWN serve.score span (same wall window, its trace id)
+        # on this batcher thread — the third thread hop of the request's
+        # flow chain. Recorded BEFORE completion so the handler thread
+        # observes batch_fill after settlement.
+        by_request: dict[int, tuple] = {}
+        for item in items:
+            by_request.setdefault(id(item.request), (item.request, []))[
+                1
+            ].append(item)
+        span_log = telemetry.get_span_log()
+        for req, req_items in by_request.values():
+            req.batch_fill = len(items)
+            handoff = req.trace
+            if handoff is not None and handoff.ctx is not None:
+                # dsst: ignore[span-discipline] one shared scoring window fans out into N per-request records; a with-span per request would nest N overlapping scopes on this thread
+                span_log.record(
+                    "serve.score", t0_wall, score_dur,
+                    trace=handoff.ctx,
+                    images=len(req_items), batch_fill=len(items),
+                )
         for item, row in zip(items, rows):
             item.request.complete_item(item.index, row)
             self._retire(item)

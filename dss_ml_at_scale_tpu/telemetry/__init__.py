@@ -28,19 +28,24 @@ from .registry import (
     log_buckets,
 )
 from .spans import SpanLog, export_perfetto, to_perfetto
+from . import flightrec, tracecontext
+from .tracecontext import Handoff, TraceContext
 
 __all__ = [
     "CompileTracker",
     "DEFAULT_BUCKETS",
     "DeviceMonitor",
+    "Handoff",
     "MetricFamily",
     "MetricsRegistry",
     "SampledObserver",
     "SpanLog",
+    "TraceContext",
     "collect_remote_snapshots",
     "counter",
     "device_memory_stats",
     "export_perfetto",
+    "flightrec",
     "gauge",
     "get_registry",
     "get_span_log",
@@ -52,6 +57,7 @@ __all__ = [
     "snapshot",
     "span",
     "to_perfetto",
+    "tracecontext",
     "write_exports",
 ]
 

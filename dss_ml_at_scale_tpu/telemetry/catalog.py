@@ -1,4 +1,5 @@
-"""The metric-name catalog: every series the package may emit.
+"""The metric- and span-name catalogs: every series and every span name
+the package may emit.
 
 The registry is get-or-create by design (call sites never coordinate),
 which means a typo'd name silently forks a series and a renamed metric
@@ -10,8 +11,15 @@ package must appear here with the matching kind, and every entry here
 must still have a call site. Mirrors ``resilience.faults.KNOWN_SITES``
 (the ``fault-sites`` rule) exactly.
 
-Adding a metric: add the call site AND the entry here (the lint fails
-on either alone). Removing one: remove both.
+:data:`KNOWN_SPANS` is the same gate for span names (the
+``span-discipline`` rule): trace tooling groups and attributes by span
+name (``dsst trace attribution`` buckets ``reader.next`` as data wait,
+``train_step`` as compute), so a typo'd span name silently falls out of
+every breakdown. Every literal name at a ``span()`` call site must be
+declared here, and every declared name must still have a call site.
+
+Adding a metric or span: add the call site AND the entry here (the lint
+fails on either alone). Removing one: remove both.
 """
 
 from __future__ import annotations
@@ -34,6 +42,9 @@ KNOWN_METRICS: dict[str, str] = {
     "retry_total": "counter",
     "runs_interrupted_total": "counter",
     "worker_readmitted_total": "counter",
+    # -- tracing / flight recorder ----------------------------------------
+    "flight_recorder_bytes_total": "counter",
+    "trace_spans_total": "counter",
     # -- device / compile --------------------------------------------------
     "device_hbm_bytes_in_use": "gauge",
     "device_hbm_bytes_limit": "gauge",
@@ -74,4 +85,37 @@ KNOWN_METRICS: dict[str, str] = {
     "serving_ready": "gauge",
     "serving_request_seconds": "histogram",
     "serving_time_in_queue_seconds": "histogram",
+}
+
+# Span name -> what the span covers. The ``span-discipline`` lint rule
+# (``dsst lint``) reconciles ``span()`` call sites against this in both
+# directions; ``dsst trace attribution`` buckets step spans by these
+# names (see _ATTRIBUTION in config/commands.py).
+KNOWN_SPANS: dict[str, str] = {
+    # -- training ----------------------------------------------------------
+    "fit": "one Trainer.fit call, open for the whole run",
+    "train_epoch": "one epoch's committed-step loop",
+    "train_step": "one train-step dispatch (+ verdict fetch when "
+                  "health-supervised)",
+    "eval": "one epoch's validation pass",
+    "checkpoint": "orbax save dispatch for one step",
+    "checkpoint.finalize": "manifest finalizer: async-save wait + "
+                           "hash + journal commit",
+    "health_rollback": "restore-from-checkpoint on a health rollback",
+    # -- input pipeline ----------------------------------------------------
+    "reader.next": "feeder thread pulling one host batch from the reader",
+    "feeder.place": "feeder thread staging + sharding one batch onto "
+                    "devices",
+    "mesh.plan": "MeshBatchPlacer building a placement plan for a new "
+                 "batch structure (cache miss)",
+    # -- serving -----------------------------------------------------------
+    "serve.request": "one HTTP /predict request, admission to response",
+    "serve.decode": "decode pool turning one request's payloads into "
+                    "arrays",
+    "serve.score": "one request's share of a scored micro-batch",
+    # -- HPO ---------------------------------------------------------------
+    "trial": "one HPO trial evaluation",
+    "trial.submit": "driver-side proposal/submission of one trial",
+    # -- ingest ------------------------------------------------------------
+    "ingest": "one ingest run over a raw image tree",
 }

@@ -147,6 +147,15 @@ class RunStore:
         if _run_cmdline is not None:
             start_event["cmdline"] = list(_run_cmdline)
         self.journal_event(**start_event)
+        # Always-on flight recorder: this run's span begin/end events go
+        # to a crash-durable tail in the run directory, so the last
+        # events of a SIGKILLed run — including spans still open at the
+        # kill — are reconstructible (`dsst trace tail`). Registered in
+        # the journal so classify_run/doctor can point at the file.
+        from ..telemetry import flightrec
+
+        self._trace_path = flightrec.enable(self.path / "flightrec.jsonl")
+        self.journal_event("trace", path=str(self._trace_path))
 
     # -- logging ----------------------------------------------------------
 
@@ -260,6 +269,12 @@ class RunStore:
         meta.update(status=status, end_time=_now())
         self._write_json("meta.json", meta)
         self._metrics.close()
+        # Stop recording into a finished run — but only if the recorder
+        # still targets THIS run's tail (a newer run may have
+        # re-targeted it already; disable(path) is a no-op then).
+        from ..telemetry import flightrec
+
+        flightrec.disable(self._trace_path)
 
     # -- context manager (finish() may never run on a hard crash; `with`
     # scopes the metrics handle to the block and stamps the outcome) ------
@@ -364,6 +379,7 @@ def classify_run(run_dir: str | os.PathLike) -> dict:
         "checkpoint_dir": None,
         "cmdline": None,
         "cwd": None,
+        "trace_file": None,
         "heartbeat_age_s": None,
     }
     try:
@@ -384,6 +400,10 @@ def classify_run(run_dir: str | os.PathLike) -> dict:
         elif e["event"] == "config":
             if e.get("checkpoint_dir"):
                 out["checkpoint_dir"] = e["checkpoint_dir"]
+        elif e["event"] == "trace":
+            # The flight-recorder tail this run's writer recorded into —
+            # where a dead run's last (and in-flight) spans live.
+            out["trace_file"] = e.get("path")
         elif e["event"] in ("checkpoint", "manifest_repair"):
             out["last_step"] = e.get("step")
             out["checkpoint_dir"] = e.get("checkpoint_dir")

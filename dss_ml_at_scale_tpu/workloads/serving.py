@@ -43,11 +43,14 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import telemetry
+from ..telemetry import tracecontext
+from ..utils.jsonl import JsonlWriter
 from ..serving import (
     DeadlineExceeded,
     Lifecycle,
@@ -223,7 +226,9 @@ def make_server(predictor, host: str = "127.0.0.1",
                 port: int = 8008, *,
                 max_body_bytes: int = 64 * 1024 * 1024,
                 max_instances: int = 1024,
-                config: SchedulerConfig | None = None) -> ThreadingHTTPServer:
+                config: SchedulerConfig | None = None,
+                access_log: str | os.PathLike | None = None,
+                ) -> ThreadingHTTPServer:
     """A ready-to-run server (caller picks ``serve_forever`` vs thread).
 
     The returned server owns a started :class:`ServingScheduler`
@@ -235,7 +240,16 @@ def make_server(predictor, host: str = "127.0.0.1",
     make the server materialize (413 above the caps): without them a
     single oversized POST would be read and base64-decoded wholesale
     into memory (low-risk at the 127.0.0.1 default bind, but the caps
-    make the exposure explicit and configurable)."""
+    make the exposure explicit and configurable).
+
+    ``access_log`` (a path) enables the structured request log: one
+    JSONL row per /predict, flushed as it happens (operational
+    evidence, not durable state — a crash loses at most the in-flight
+    row). Rows carry the request's trace id (``request_id``, the same
+    value the ``X-DSST-Trace`` response header echoes), the HTTP
+    status, image count, measured ``queue_ms``, and the ``batch_fill``
+    of the micro-batch the request scored in — enough to answer "what
+    did request X experience" without a debugger."""
 
     # Registered before the first request so a scrape of a fresh server
     # already declares the series (# TYPE lines render for empty
@@ -250,6 +264,7 @@ def make_server(predictor, host: str = "127.0.0.1",
 
     lifecycle = Lifecycle()
     scheduler = ServingScheduler(predictor, config, lifecycle=lifecycle)
+    access = JsonlWriter(access_log) if access_log else None
 
     _known_paths = frozenset(("/healthz", "/readyz", "/metrics", "/predict"))
 
@@ -263,6 +278,15 @@ def make_server(predictor, host: str = "127.0.0.1",
         # client would pin a thread forever.
         timeout = 60
 
+        # Per-request state (one handler instance serves one connection,
+        # requests on it are sequential): the trace id echoed back as
+        # X-DSST-Trace, the last response code, and the scheduler's
+        # accounting side channel — what the access-log row is built of.
+        _trace_id = None
+        _last_code = None
+        _req_info = None
+        _req_images = None
+
         def log_message(self, *a):  # quiet by default; errors still raise
             pass
 
@@ -275,10 +299,16 @@ def make_server(predictor, host: str = "127.0.0.1",
         def _json(self, code: int, payload: dict, headers=None) -> None:
             if code >= 400:
                 error_counter.labels(code=str(code)).inc()
+            self._last_code = code
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if self._trace_id is not None:
+                # The request's causal identity, echoed to the client:
+                # quote it back and `dsst trace` can pull the request's
+                # full cross-thread timeline.
+                self.send_header("X-DSST-Trace", self._trace_id)
             for name, value in (headers or {}).items():
                 self.send_header(name, value)
             self.end_headers()
@@ -296,6 +326,7 @@ def make_server(predictor, host: str = "127.0.0.1",
 
         def do_GET(self):
             t0 = time.perf_counter()
+            self._trace_id = None  # keep-alive: no stale header echo
             try:
                 if self.path == "/healthz":
                     # Liveness: 200 even while draining — a draining
@@ -331,11 +362,34 @@ def make_server(predictor, host: str = "127.0.0.1",
                 self._post()
             finally:
                 self._observe(t0)
+                if access is not None and self.path == "/predict":
+                    info = self._req_info or {}
+                    access.write({
+                        "ts": round(time.time(), 3),
+                        "request_id": self._trace_id,
+                        "status": self._last_code,
+                        "images": self._req_images,
+                        "queue_ms": info.get("queue_ms"),
+                        "batch_fill": info.get("batch_fill"),
+                    })
 
         def _post(self):
+            self._trace_id = None  # keep-alive: no stale header echo
             if self.path != "/predict":
                 self._json(404, {"error": f"no route {self.path}"})
                 return
+            # One trace per request, opened at the HTTP edge: everything
+            # downstream (admission, decode pool, batcher) shares this
+            # trace_id, and the response echoes it as X-DSST-Trace.
+            self._last_code = None
+            self._req_info = None
+            self._req_images = None
+            with tracecontext.trace(kind="request") as tctx:
+                self._trace_id = tctx.trace_id
+                with telemetry.span("serve.request"):
+                    self._post_predict()
+
+        def _post_predict(self):
             # Responding WITHOUT consuming the body would leave its
             # bytes in the keep-alive stream, desyncing the next
             # request on this connection — these early returns must
@@ -381,7 +435,9 @@ def make_server(predictor, host: str = "127.0.0.1",
                     jpegs = [body]  # raw single JPEG
                 if not jpegs:
                     raise ValueError("empty instances")
-                preds = scheduler.submit(jpegs)
+                self._req_images = len(jpegs)
+                self._req_info = {}
+                preds = scheduler.submit(jpegs, info=self._req_info)
             except QueueFull as e:
                 # Backpressure, not failure: the client should retry
                 # after the queue's measured time-to-capacity.
@@ -423,14 +479,17 @@ class _ServingHTTPServer(ThreadingHTTPServer):
 
 
 def serve_in_thread(predictor, host: str = "127.0.0.1", port: int = 0, *,
-                    config: SchedulerConfig | None = None) -> ServerHandle:
+                    config: SchedulerConfig | None = None,
+                    access_log: str | os.PathLike | None = None,
+                    ) -> ServerHandle:
     """A running server as a :class:`ServerHandle` — the test and
     embedding entry point; ``port=0`` picks a free port
     (``handle.port``). ``handle.close()`` performs the graceful drain
     (stop admitting → finish queued work → stop the accept loop → close
     the socket), so embedders never leak the server socket or kill
     in-flight requests mid-write."""
-    server = make_server(predictor, host, port, config=config)
+    server = make_server(predictor, host, port, config=config,
+                         access_log=access_log)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return ServerHandle(server, thread)

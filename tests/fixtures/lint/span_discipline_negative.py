@@ -1,0 +1,16 @@
+"""Fixture (known={"train_step": "", "train_epoch": ""}): declared
+names, a forwarding facade, and a reason-suppressed raw record — no
+findings."""
+
+from dss_ml_at_scale_tpu import telemetry
+
+
+def span(name, **args):
+    return telemetry.span(name, **args)      # forwarder: variable ok
+
+
+def instrument():
+    with telemetry.span("train_step", step=3):
+        pass
+    # dsst: ignore[span-discipline] duration computed by the caller; a with-span would misreport when the work ran
+    telemetry.get_span_log().record("train_epoch", 0.0, 1.0)

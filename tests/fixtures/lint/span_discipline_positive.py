@@ -1,0 +1,15 @@
+"""Fixture (known={"train_step": "", "dead.span": ""}): 4 findings —
+undeclared span name, non-literal name outside the forwarding layer,
+raw record() outside telemetry/, dead registry entry."""
+
+from dss_ml_at_scale_tpu import telemetry
+
+
+def instrument(name):
+    with telemetry.span("typo_span"):        # not declared
+        pass
+    with telemetry.span(name):               # non-literal outside facade
+        pass
+    telemetry.get_span_log().record("late", 0.0, 1.0)  # raw record
+    with telemetry.span("train_step"):       # fine (keeps entry live)
+        pass

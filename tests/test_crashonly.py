@@ -152,7 +152,13 @@ def test_journal_classifies_live_finished_and_interrupted(tmp_path):
     run.finish()
     assert classify_run(run.path)["effective_status"] == "FINISHED"
     events = [e["event"] for e in read_journal(run.path)]
-    assert events == ["start", "checkpoint", "finish"]
+    # "trace" right after "start": every run registers its flight-
+    # recorder tail in the journal (what classify_run's trace_file and
+    # `dsst trace --run` resolve).
+    assert events == ["start", "trace", "checkpoint", "finish"]
+    assert classify_run(run.path)["trace_file"] == str(
+        (run.path / "flightrec.jsonl").absolute()
+    )
 
 
 def test_config_event_alone_makes_run_revivable(tmp_path):

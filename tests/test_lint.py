@@ -47,6 +47,9 @@ from dss_ml_at_scale_tpu.analysis.checkers.no_print import NoPrintChecker
 from dss_ml_at_scale_tpu.analysis.checkers.retrace_hazard import (
     RetraceHazardChecker,
 )
+from dss_ml_at_scale_tpu.analysis.checkers.span_discipline import (
+    SpanDisciplineChecker,
+)
 from dss_ml_at_scale_tpu.analysis.checkers.telemetry_registry import (
     TelemetryRegistryChecker,
 )
@@ -108,6 +111,10 @@ def test_durable_write_clean():
     _rule_clean("durable-write")
 
 
+def test_span_discipline_clean():
+    _rule_clean("span-discipline")
+
+
 # -- per-rule fixtures --------------------------------------------------------
 
 # rule -> (checker factory, expected positive finding count)
@@ -133,6 +140,16 @@ RULES = {
     "telemetry_registry_neg": (
         lambda: TelemetryRegistryChecker(
             known={"requests_total": "counter", "depth": "gauge"}
+        ), None,
+    ),
+    "span_discipline_pos": (
+        lambda: SpanDisciplineChecker(
+            known={"train_step": "", "dead.span": ""}
+        ), 4,
+    ),
+    "span_discipline_neg": (
+        lambda: SpanDisciplineChecker(
+            known={"train_step": "", "train_epoch": ""}
         ), None,
     ),
 }

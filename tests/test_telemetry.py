@@ -164,11 +164,18 @@ def test_span_log_and_perfetto_roundtrip(tmp_path):
     trace = json.loads(out.read_text())  # valid JSON by construction
     assert trace["displayTimeUnit"] == "ms"
     evs = trace["traceEvents"]
-    assert len(evs) == 2
-    for e in evs:
-        assert e["ph"] == "X"
+    # ph "M" metadata labels the lanes (process + this thread's name);
+    # the spans themselves are ph "X" complete events.
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert {e["name"] for e in meta} == {"process_name", "thread_name"}
+    assert any(
+        e["name"] == "thread_name" and e["args"]["name"] for e in meta
+    )
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 2
+    for e in xs:
         assert e["dur"] >= 0
-    # Monotonic microsecond timestamps.
+    # Monotonic microsecond timestamps (metadata first at ts 0).
     ts = [e["ts"] for e in evs]
     assert ts == sorted(ts)
 
@@ -188,7 +195,8 @@ def test_to_perfetto_sorts_unordered_events():
         {"name": "a", "ts": 1.0, "dur": 0.1},
     ]
     trace = to_perfetto(events)
-    assert [e["name"] for e in trace["traceEvents"]] == ["a", "b"]
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["a", "b"]
 
 
 # ---------------------------------------------------------------------------
@@ -461,7 +469,7 @@ def test_telemetry_cli_table_json_and_perfetto(tmp_path, capsys):
     ]) == 0
     capsys.readouterr()
     trace = json.loads(trace_out.read_text())
-    names = [e["name"] for e in trace["traceEvents"]]
+    names = [e["name"] for e in trace["traceEvents"] if e["ph"] == "X"]
     assert names == ["eval", "epoch"]  # sorted by ts
 
     # Usage errors are loud, not tracebacks.

@@ -1,0 +1,467 @@
+"""Causal tracing + flight recorder: IDs across threads, crash tails.
+
+What must hold:
+
+- contextvar propagation: spans under an active trace share its
+  trace_id and form a parent chain; threads do NOT inherit a trace
+  (that's what Handoffs are for).
+- the real pipeline boundaries carry handoffs: a `Feeder` thread's
+  reader/place spans and the consumer's step span share one step trace;
+  the serving scheduler links handler → decode pool → batcher for one
+  request across three threads.
+- the flight recorder's tail survives reconstruction: begin-only spans
+  (open at a kill) come back as OPEN, torn last lines are tolerated,
+  and `dsst trace tail/export/attribution` work from the file alone.
+- the Perfetto export stitches one trace across threads with flow
+  events and labels lanes with thread names.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from dss_ml_at_scale_tpu import telemetry
+from dss_ml_at_scale_tpu.telemetry import flightrec, tracecontext
+from dss_ml_at_scale_tpu.telemetry.spans import (
+    SpanLog,
+    load_span_jsonl,
+    to_perfetto,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    flightrec.disable()
+    yield
+    telemetry.reset()
+    flightrec.disable()
+
+
+# ---------------------------------------------------------------------------
+# tracecontext
+# ---------------------------------------------------------------------------
+
+def test_spans_under_a_trace_share_its_id_and_chain_parents():
+    log = SpanLog()
+    with tracecontext.trace(kind="request") as ctx:
+        with log.span("outer"):
+            inner_ctx = tracecontext.current()
+            with log.span("inner"):
+                pass
+    inner, outer = log.events()
+    assert inner["trace"] == outer["trace"] == ctx.trace_id
+    assert inner["kind"] == outer["kind"] == "request"
+    assert outer["parent"] == ctx.span_id
+    # inner's parent is outer's span id (the contextvar advanced).
+    assert inner["parent"] == outer["span"]
+    assert inner["parent"] == inner_ctx.span_id
+    # Outside the trace: no trace fields.
+    with log.span("free"):
+        pass
+    assert "trace" not in log.events()[-1]
+    assert tracecontext.current() is None
+
+
+def test_threads_do_not_inherit_traces_but_handoffs_carry_them():
+    log = SpanLog()
+    seen = {}
+
+    def worker(handoff):
+        seen["bare"] = tracecontext.current()
+        with handoff.activate():
+            with log.span("work"):
+                pass
+
+    with tracecontext.trace(kind="step") as ctx:
+        h = tracecontext.Handoff.capture()
+        t = threading.Thread(target=worker, args=(h,))
+        t.start()
+        t.join()
+    assert seen["bare"] is None  # no implicit inheritance
+    work = log.events()[-1]
+    assert work["trace"] == ctx.trace_id
+    # A None handoff activates as a no-op.
+    with tracecontext.Handoff(None).activate():
+        assert tracecontext.current() is None
+
+
+# ---------------------------------------------------------------------------
+# real boundaries: feeder thread, serving decode pool + batcher
+# ---------------------------------------------------------------------------
+
+def test_feeder_thread_and_consumer_share_one_step_trace():
+    from dss_ml_at_scale_tpu.data.prefetch import Feeder
+
+    source = [{"i": 0}, {"i": 1}]
+    feeder = Feeder(iter(source), place=lambda b: b, name="t")
+    traces = []
+    try:
+        for batch, _prov in feeder:
+            with feeder.last_handoff.activate(), telemetry.span(
+                "train_step", step=batch["i"]
+            ):
+                pass
+            traces.append(feeder.last_handoff.ctx.trace_id)
+    finally:
+        feeder.close()
+    assert len(set(traces)) == 2  # one trace per batch
+    events = telemetry.get_span_log().events()
+    for trace_id in traces:
+        group = [e for e in events if e.get("trace") == trace_id]
+        names = {e["name"] for e in group}
+        assert {"reader.next", "feeder.place", "train_step"} <= names
+        # The step span ran on THIS thread, the others on the feeder's.
+        tids = {e["name"]: e["tid"] for e in group}
+        assert tids["train_step"] == threading.get_ident()
+        assert tids["reader.next"] != tids["train_step"]
+        assert all(e["kind"] == "step" for e in group)
+
+
+def test_serving_request_spans_cross_three_threads_with_one_trace():
+    from dss_ml_at_scale_tpu.serving import SchedulerConfig, ServingScheduler
+
+    class Predictor:
+        micro_batch = 4
+
+        def predict(self, payloads):
+            time.sleep(0.001)
+            return [{"v": p} for p in payloads]
+
+    sched = ServingScheduler(
+        Predictor(), SchedulerConfig(batch_window_ms=1.0)
+    ).start()
+    sched.lifecycle.mark_ready()
+    try:
+        with tracecontext.trace(kind="request") as ctx:
+            with telemetry.span("serve.request"):
+                rows = sched.submit([b"a", b"b"])
+        assert [r["v"] for r in rows] == [b"a", b"b"]
+    finally:
+        sched.lifecycle.start_drain()
+        sched.drain(2.0)
+    events = [
+        e for e in telemetry.get_span_log().events()
+        if e.get("trace") == ctx.trace_id
+    ]
+    by_name = {e["name"]: e for e in events}
+    assert {"serve.request", "serve.decode", "serve.score"} <= set(by_name)
+    # ≥3 distinct threads: handler (this one), decode worker, batcher.
+    tids = {e["tid"] for e in events}
+    assert len(tids) >= 3
+    assert by_name["serve.request"]["tid"] == threading.get_ident()
+    assert by_name["serve.score"]["args"]["batch_fill"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_tail_preserves_open_spans_and_heals_torn_tail(
+    tmp_path,
+):
+    tail = tmp_path / "flightrec.jsonl"
+    flightrec.enable(tail)
+    log = SpanLog()
+    with tracecontext.trace(kind="step"):
+        with log.span("closed_span"):
+            pass
+        # An "open" span: emit the begin by hand the way a SIGKILL
+        # would leave one — enter without exiting.
+        cm = log.span("train_step", step=7)
+        cm.__enter__()
+    flightrec.disable()
+    # Torn last line: a kill mid-append leaves half a record.
+    with open(tail, "a", encoding="utf-8") as f:
+        f.write('{"ph": "B", "name": "torn')
+
+    events = flightrec.read_events(tail)
+    complete, opens = flightrec.reconstruct(events)
+    assert [e["name"] for e in complete] == ["closed_span"]
+    assert [e["name"] for e in opens] == ["train_step"]
+    assert opens[0]["args"] == {"step": 7}
+    # The loader view: open spans surface with args.open=True.
+    loaded = load_span_jsonl(tail)
+    opened = [e for e in loaded if e.get("args", {}).get("open")]
+    assert [e["name"] for e in opened] == ["train_step"]
+    cm.__exit__(None, None, None)
+
+
+def test_reconstruct_pairs_by_trace_and_span():
+    # Span ids are unique only WITHIN a trace: an E event must never
+    # close another trace's B that happens to share the 32-bit id.
+    events = [
+        {"ph": "B", "name": "a", "ts": 1.0, "trace": "t1", "span": "s1"},
+        {"ph": "B", "name": "b", "ts": 2.0, "trace": "t2", "span": "s1"},
+        {"ph": "E", "name": "b", "ts": 3.0, "trace": "t2", "span": "s1",
+         "dur": 1.0},
+    ]
+    complete, opens = flightrec.reconstruct(events)
+    assert [e["name"] for e in complete] == ["b"]
+    assert [(e["name"], e["trace"]) for e in opens] == [("a", "t1")]
+
+
+def test_cli_trace_tail_window_smaller_than_open_count(tmp_path, capsys):
+    # When open spans alone fill -n, the closed window is zero — which
+    # must mean ZERO closed rows, not the whole log (list[-0:] trap).
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    tail = tmp_path / "flightrec.jsonl"
+    flightrec.enable(tail)
+    log = SpanLog()
+    cms = []
+    with tracecontext.trace(kind="step"):
+        for i in range(3):
+            with log.span("train_step", step=i):
+                pass
+        for i in range(2):
+            cm = log.span("checkpoint", step=i)
+            cm.__enter__()
+            cms.append(cm)
+    flightrec.disable()
+    assert main(["trace", "tail", "--file", str(tail), "-n", "2"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("OPEN") >= 2
+    assert "train_step" not in out  # no closed rows leaked into the window
+    for cm in cms:
+        cm.__exit__(None, None, None)
+
+
+def test_flight_recorder_disable_is_scoped_to_its_path(tmp_path):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    flightrec.enable(a)
+    flightrec.enable(b)  # newer run re-targets
+    flightrec.disable(a)  # stale disable: no-op
+    assert flightrec.get_recorder().path == b
+    flightrec.disable(b)
+    assert flightrec.get_recorder().path is None
+
+
+def test_run_store_registers_and_scopes_the_recorder(tmp_path):
+    from dss_ml_at_scale_tpu.tracking import RunStore, classify_run
+
+    store = RunStore(tmp_path, "exp", run_name="r")
+    tail = store.path / "flightrec.jsonl"
+    assert flightrec.get_recorder().path == tail.absolute()
+    with telemetry.span("fit", max_epochs=1):
+        pass
+    store.finish()
+    assert flightrec.get_recorder().path is None
+    cls = classify_run(store.path)
+    assert cls["trace_file"] == str(tail.absolute())
+    complete, opens = flightrec.reconstruct(flightrec.read_events(tail))
+    assert any(e["name"] == "fit" for e in complete)
+    assert opens == []  # a clean finish closes everything
+
+
+# ---------------------------------------------------------------------------
+# perfetto round trip with flows
+# ---------------------------------------------------------------------------
+
+def test_perfetto_flow_events_stitch_a_trace_across_threads(tmp_path):
+    log = SpanLog()
+
+    def worker(handoff):
+        with handoff.activate(), log.span("stage_b"):
+            pass
+
+    with tracecontext.trace(kind="request") as ctx:
+        with log.span("stage_a"):
+            pass
+        t = threading.Thread(target=worker,
+                             args=(tracecontext.Handoff.capture(),),
+                             name="worker-b")
+        t.start()
+        t.join()
+
+    jsonl = tmp_path / "spans.jsonl"
+    log.dump_jsonl(jsonl)
+    trace = to_perfetto(load_span_jsonl(jsonl))
+    evs = trace["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"stage_a", "stage_b"}
+    assert all(e["args"]["trace"] == ctx.trace_id for e in xs)
+    # One flow arrow: s anchored in stage_a's slice, f in stage_b's.
+    s = [e for e in evs if e["ph"] == "s"]
+    f = [e for e in evs if e["ph"] == "f"]
+    assert len(s) == 1 and len(f) == 1
+    assert s[0]["id"] == f[0]["id"]
+    assert s[0]["tid"] != f[0]["tid"]
+    assert f[0]["bp"] == "e"
+    # Lanes are named.
+    thread_names = {
+        e["args"]["name"] for e in evs
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert "worker-b" in thread_names
+    # Timestamps monotonic across the whole stream.
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+
+
+# ---------------------------------------------------------------------------
+# dsst trace CLI
+# ---------------------------------------------------------------------------
+
+def _record_fake_run(tmp_path):
+    """A miniature training timeline on a recorder tail: two complete
+    steps (one slow), plus an open step span (the 'killed' one). The
+    reader/place spans run on a real feeder thread so the export has a
+    cross-thread hop to stitch with flow events."""
+    tail = tmp_path / "flightrec.jsonl"
+    flightrec.enable(tail)
+    log = SpanLog()
+
+    def feed(handoff):
+        with handoff.activate():
+            with log.span("reader.next", feeder="train"):
+                pass
+            with log.span("feeder.place", feeder="train"):
+                pass
+
+    open_cm = None
+    for i, dur in enumerate((0.001, 0.03, None)):
+        with tracecontext.trace(kind="step"):
+            t = threading.Thread(
+                target=feed, args=(tracecontext.Handoff.capture(),),
+                name="feeder-train",
+            )
+            t.start()
+            t.join()
+            if dur is None:
+                open_cm = log.span("train_step", step=i)
+                open_cm.__enter__()
+            else:
+                with log.span("train_step", step=i):
+                    time.sleep(dur)
+    flightrec.disable()
+    return tail, open_cm
+
+
+def test_cli_trace_tail_export_attribution(tmp_path, capsys):
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    tail, open_cm = _record_fake_run(tmp_path)
+
+    assert main(["trace", "tail", "--file", str(tail)]) == 0
+    out = capsys.readouterr().out
+    assert "OPEN" in out and "train_step" in out
+    assert "1 span(s) were OPEN" in out
+
+    out_file = tmp_path / "trace.json"
+    assert main(["trace", "export", "--file", str(tail),
+                 "--out", str(out_file)]) == 0
+    capsys.readouterr()
+    trace = json.loads(out_file.read_text())
+    assert any(e["ph"] == "s" for e in trace["traceEvents"])
+
+    assert main(["trace", "attribution", "--file", str(tail),
+                 "--zscore", "0.9", "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["steps"] == 2  # the open step has no closed compute
+    assert report["anomalies"], "the 30x slower step must flag"
+    anomaly_children = {
+        s["name"] for s in report["anomalies"][0]["spans"]
+    }
+    assert {"reader.next", "feeder.place", "train_step"} <= anomaly_children
+    assert report["open_spans"] == ["train_step"]
+
+    # Usage errors are loud, not tracebacks.
+    assert main(["trace", "tail"]) == 2
+    assert main(["trace", "tail", "--file", str(tmp_path / "nope")]) == 2
+    capsys.readouterr()
+    open_cm.__exit__(None, None, None)
+
+
+def test_cli_trace_tail_reads_the_run_journal(tmp_path, capsys):
+    from dss_ml_at_scale_tpu.config.cli import main
+    from dss_ml_at_scale_tpu.tracking import RunStore
+
+    store = RunStore(tmp_path, "exp")
+    with telemetry.span("fit", max_epochs=1):
+        pass
+    store.finish()
+    assert main(["trace", "tail", "--run", str(store.path)]) == 0
+    assert "fit" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# serving access log
+# ---------------------------------------------------------------------------
+
+def test_access_log_rows_for_200_429_503(tmp_path):
+    import http.client
+
+    from dss_ml_at_scale_tpu.serving import SchedulerConfig
+    from dss_ml_at_scale_tpu.workloads.serving import serve_in_thread
+
+    class Predictor:
+        micro_batch = 2
+
+        def predict(self, payloads):
+            time.sleep(0.05)
+            return [{"v": 1} for _ in payloads]
+
+    log_path = tmp_path / "access.jsonl"
+    handle = serve_in_thread(
+        Predictor(),
+        config=SchedulerConfig(queue_depth=2, batch_window_ms=1.0,
+                               deadline_ms=40.0),
+        access_log=log_path,
+    )
+    try:
+        def post(n):
+            conn = http.client.HTTPConnection("127.0.0.1", handle.port,
+                                              timeout=5)
+            body = json.dumps(
+                {"instances": ["aGk=" for _ in range(n)]}
+            )
+            conn.request("POST", "/predict", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            header = resp.getheader("X-DSST-Trace")
+            conn.close()
+            return resp.status, header
+
+        statuses = set()
+        headers = []
+        # The scorer takes 50ms against a 40ms deadline and depth 2:
+        # concurrent posts collect 200s... the deadline 503s the slow
+        # ones, and overflow admissions 429.
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(post(1))
+            )
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+            time.sleep(0.005)
+        for t in threads:
+            t.join()
+        statuses = {s for s, _ in results}
+        headers = [h for _, h in results]
+        assert {429, 503} & statuses or 200 in statuses
+        assert all(h for h in headers)  # every response echoes its id
+    finally:
+        handle.close(2.0)
+
+    rows = [json.loads(l) for l in log_path.read_text().splitlines()]
+    assert len(rows) == 8
+    by_status: dict[int, list] = {}
+    for r in rows:
+        by_status.setdefault(r["status"], []).append(r)
+    # Row ids match the echoed headers 1:1.
+    assert sorted(r["request_id"] for r in rows) == sorted(headers)
+    for r in rows:
+        assert r["images"] == 1
+        if r["status"] == 200:
+            assert r["queue_ms"] >= 0 and r["batch_fill"] >= 1
+        if r["status"] == 429:
+            assert r["batch_fill"] is None  # never entered the pipeline
