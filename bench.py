@@ -745,6 +745,37 @@ def _bench_pipeline(jax, task, compute_ips: float, *,
         )
     except OSError:
         pass
+
+    # -- stage 5: thread-sanitizer overhead -----------------------------------
+    # The SAME traced loop as stage 4 (recorder off on both sides), once
+    # disarmed — plain threading objects, the production configuration —
+    # and once inside a `dsst sanitize` scope, where every lock the
+    # feeder/telemetry path creates is interposed and every
+    # _guarded_by_lock attribute access is checked. Disarmed is
+    # zero-cost BY CONSTRUCTION (nothing is patched; stage 4 already
+    # measured this loop), so the artifact's job is the armed cost: the
+    # price of running a soak or CI pass with DSST_SANITIZE=1.
+    from dss_ml_at_scale_tpu.analysis.sanitize import (
+        build_result,
+        sanitize_scope,
+    )
+
+    state, san_off_step_s = _traced_loop(state, None)
+    with sanitize_scope() as san_scope:
+        # The feeder (and its locks) are created INSIDE the armed scope
+        # — instrumentation covers objects constructed while armed.
+        state, san_on_step_s = _traced_loop(state, None)
+    san_res = build_result(san_scope, ["bench"], full_run=False)
+    san_overhead = (san_on_step_s - san_off_step_s) / san_off_step_s \
+        if san_off_step_s > 0 else 0.0
+    out["sanitizer_off_step_ms"] = round(san_off_step_s * 1e3, 4)
+    out["sanitizer_on_step_ms"] = round(san_on_step_s * 1e3, 4)
+    # Signed, like the recorder fraction: a large |negative| means the
+    # window was too noisy to trust, which is itself worth seeing.
+    out["sanitizer_overhead_fraction"] = round(san_overhead, 4)
+    out["sanitizer_locks_instrumented"] = san_res.stats["locks"]
+    out["sanitizer_order_edges"] = san_res.stats["edges"]
+    out["sanitizer_findings"] = len(san_res.findings)
     return out
 
 

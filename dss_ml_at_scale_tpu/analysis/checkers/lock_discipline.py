@@ -18,6 +18,15 @@ knowledge enforceable:
   functions under a ``with <module-level Lock>:`` — the pattern
   ``hpo/shipping.py`` gets right and ``Thread(target=...)`` entry
   points make mandatory.
+- any class that *owns a thread* — constructs ``threading.Thread`` in
+  its body, or is handed one (an ``__init__`` parameter named
+  ``thread``) — must declare ``_guarded_by_lock`` or carry a reasoned
+  suppression on the class line. Owning a thread is what makes state
+  shared; an owner with no declared contract is invisible to both this
+  rule's attribute check AND the runtime sanitizer (``dsst sanitize``
+  enforces the same declarations dynamically), so new threaded code
+  cannot opt out of either tier silently. A class whose only
+  cross-thread channels are queues/events suppresses with that reason.
 
 The declaration is the contract: attributes NOT listed are not checked,
 so adopting the rule is incremental per class.
@@ -94,11 +103,74 @@ class LockDisciplineChecker(Checker):
     def check_file(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
         parents = ctx.parents
+        thread_names = self._thread_ctor_names(ctx.tree)
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ClassDef):
                 out.extend(self._check_class(ctx, node, parents))
+                out.extend(
+                    self._check_thread_owner(ctx, node, thread_names)
+                )
         out.extend(self._check_module_globals(ctx, parents))
         return out
+
+    # -- thread ownership requires a declared contract ---------------------
+
+    @staticmethod
+    def _thread_ctor_names(tree) -> tuple[set[str], set[str]]:
+        """(bare Thread aliases, threading-module aliases) in scope —
+        `from threading import Thread [as T]` and `import threading
+        [as t]` must both feed the owner check, or a rename evades the
+        very gate built to stop silent opt-outs."""
+        bare: set[str] = set()
+        modules: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and (
+                (node.module or "").split(".")[0] == "threading"
+            ):
+                for a in node.names:
+                    if a.name == "Thread":
+                        bare.add(a.asname or a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[0] == "threading":
+                        modules.add(a.asname or a.name.split(".")[0])
+        return bare, modules
+
+    def _check_thread_owner(self, ctx, cls: ast.ClassDef,
+                            thread_names) -> list[Finding]:
+        bare, modules = thread_names
+        guarded, _ = _guarded_tuple(cls)
+        if guarded:
+            return []
+        owns = None
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) and (
+                (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "Thread"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in modules
+                )
+                or (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in bare
+                )
+            ):
+                owns = "constructs threading.Thread"
+                break
+            if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+                if any(a.arg == "thread" for a in node.args.args):
+                    owns = "is handed a thread in __init__"
+        if owns is None:
+            return []
+        return [self.finding(
+            ctx, cls.lineno,
+            f"class {cls.name} {owns} but declares no _guarded_by_lock "
+            "contract — thread-owning classes must name their shared "
+            "state (checked here statically and by `dsst sanitize` at "
+            "runtime) or suppress with the reason no lock-guarded "
+            "state exists (e.g. queue/event channels only)",
+        )]
 
     # -- class attribute discipline ---------------------------------------
 

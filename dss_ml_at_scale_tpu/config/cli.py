@@ -123,6 +123,15 @@ def main(argv: list[str] | None = None) -> int:
         from ..resilience.faults import install_from_spec
 
         install_from_spec(fault_spec)
+    if os.environ.get("DSST_SANITIZE") and args.command != "sanitize":
+        # Armed before any subcommand constructs its locks/threads (and
+        # exported to subprocess workers via the inherited env): the
+        # runtime thread sanitizer rides ANY dsst command in
+        # observation mode — findings to stderr at exit, exit code
+        # untouched. `dsst sanitize` itself manages its own scope.
+        from ..analysis.sanitize import arm_observation_mode
+
+        arm_observation_mode()
     if args.platform:
         import jax
 

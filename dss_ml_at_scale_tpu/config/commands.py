@@ -2588,6 +2588,119 @@ def _cmd_audit(args: argparse.Namespace) -> int:
         return 2
 
 
+def register_sanitize(sub: argparse._SubParsersAction) -> None:
+    sz = sub.add_parser(
+        "sanitize",
+        help="runtime thread sanitizer (third analysis tier): run named "
+        "workloads with lock/thread instrumentation armed and report "
+        "lock-order cycles (potential deadlocks, with both acquisition "
+        "stacks), guarded-by violations, unjoined threads, and leaked "
+        "locks against SANITIZE_BASELINE.json",
+    )
+    sz.add_argument(
+        "--workloads", default=None, metavar="W1,W2",
+        help="comma-separated subset of workloads to run (default: all; "
+        "see --list-workloads). Subset runs skip stale-baseline "
+        "enforcement — they cannot prove an unexercised finding gone",
+    )
+    sz.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output (schema documented in README "
+        "'Runtime sanitizer') instead of text",
+    )
+    sz.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="baseline of accepted pre-existing findings (default: "
+        "SANITIZE_BASELINE.json at the repo root)",
+    )
+    sz.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline to the current findings: existing "
+        "entries keep their authored reason, new ones take --reason, "
+        "stale ones are dropped (full workload set only)",
+    )
+    sz.add_argument(
+        "--reason", default=None, metavar="TEXT",
+        help="justification recorded for entries newly added by "
+        "--update-baseline (mandatory when any exist)",
+    )
+    sz.add_argument(
+        "--list-workloads", action="store_true",
+        help="print the workload catalog and exit",
+    )
+    sz.add_argument(
+        "--list-rules", action="store_true",
+        help="print the sanitizer rule catalog and exit",
+    )
+    sz.set_defaults(fn=_cmd_sanitize)
+
+
+def _cmd_sanitize(args: argparse.Namespace) -> int:
+    from ..analysis.sanitize import (
+        DEFAULT_SANITIZE_BASELINE,
+        RULES,
+        SanitizeUsageError,
+        build_result,
+        run_workloads,
+        sanitize_scope,
+        workload_catalog,
+        workload_names,
+    )
+    from ..analysis.sanitize.report import update_baseline
+
+    try:
+        if args.list_workloads:
+            for name, desc in workload_catalog():
+                print(f"{name:12s} {desc}")
+            return 0
+        if args.list_rules:
+            for name, desc in sorted(RULES.items()):
+                print(f"{name:16s} {desc}")
+            return 0
+        names = (
+            [w.strip() for w in args.workloads.split(",") if w.strip()]
+            if args.workloads else workload_names()
+        )
+        unknown = sorted(set(names) - set(workload_names()))
+        if unknown:
+            raise SanitizeUsageError(
+                f"unknown workload(s) {', '.join(unknown)}; known: "
+                f"{', '.join(workload_names())}"
+            )
+        full_run = set(names) == set(workload_names())
+        if args.update_baseline and not full_run:
+            # The baseline is a whole-suite truth (the lint --changed /
+            # audit-subset discipline): a subset run would drop every
+            # entry its workloads never exercised.
+            raise SanitizeUsageError(
+                "--update-baseline needs the full workload set: a "
+                "subset run must never rewrite the whole baseline"
+            )
+        baseline = (
+            Path(args.baseline) if args.baseline
+            else DEFAULT_SANITIZE_BASELINE
+        )
+        with sanitize_scope() as scope:
+            run_workloads(names)
+        res = build_result(
+            scope, names, baseline_path=baseline, full_run=full_run,
+        )
+        if args.update_baseline:
+            added = update_baseline(baseline, res, args.reason)
+            print(
+                f"sanitize baseline {baseline}: "
+                f"{len(res.findings)} added ({added} with new reason), "
+                f"{len(res.baselined)} kept, "
+                f"{len(res.stale_baseline)} stale dropped"
+            )
+            return 0
+        print(res.render_json() if args.json else res.render_text())
+        return res.exit_code
+    except SanitizeUsageError as e:
+        print(f"dsst sanitize: {e}", file=sys.stderr)
+        return 2
+
+
 def register_trace(sub: argparse._SubParsersAction) -> None:
     tr = sub.add_parser(
         "trace",
@@ -2878,6 +2991,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_trace(sub)
     register_lint(sub)
     register_audit(sub)
+    register_sanitize(sub)
     from .pipeline import register_pipeline
 
     register_pipeline(sub)

@@ -72,6 +72,7 @@ def split_provenance(batch) -> tuple[Any, Any]:
     return batch, None
 
 
+# dsst: ignore[lock-discipline] no lock-guarded state: every producer/consumer crossing rides the bounded Queue or the stop Event; _done/_last_handoff are single-consumer-thread by the iterator contract
 class Feeder:
     """Background feeder thread feeding one consumer through a bounded queue.
 

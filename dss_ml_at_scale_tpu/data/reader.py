@@ -68,6 +68,7 @@ class _WorkerError:
         self.error = error
 
 
+# dsst: ignore[lock-discipline] no lock-guarded state: worker results cross threads only via the bounded results Queue and stop Event; _threads/_results are consumer-thread-only (a second concurrent iteration raises), per-worker file handles are thread-local
 class ParquetShardReader:
     """Background-threaded, sharded, optionally-infinite batch reader."""
 

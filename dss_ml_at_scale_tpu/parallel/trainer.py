@@ -435,6 +435,7 @@ class FitResult:
     auto_resumed: bool = False
 
 
+# dsst: ignore[lock-discipline] no lock-guarded state: the manifest-finalizer thread shares no mutable attribute with fit — _manifest_thread is written and joined only on the fit thread, and the finalizer body touches files + the RunStore journal (which declares its own contract)
 class Trainer:
     """Explicit epoch/step loop, one compiled train step, mesh-sharded."""
 

@@ -130,6 +130,17 @@ class Request:
             self._done.set()
             return True
 
+    def outcome(self) -> tuple[BaseException | None, list]:
+        """Settlement snapshot ``(error, results)``, read under the
+        lock. Callers used to read ``error``/``results`` directly after
+        :meth:`wait`, leaning on the ``_done`` event to publish the
+        writes — correct for waiters, but the timeout/stop paths read
+        them while a worker thread can still be settling, and the
+        runtime sanitizer (``dsst sanitize``, guarded-by rule) flags
+        exactly that. One locked snapshot serves every exit path."""
+        with self._lock:
+            return self.error, list(self.results)
+
     def wait(self, timeout: float | None = None) -> bool:
         return self._done.wait(timeout)
 

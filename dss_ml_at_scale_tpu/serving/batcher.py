@@ -31,6 +31,7 @@ def _NULL_TRACE(job):
     return contextlib.nullcontext()
 
 
+# dsst: ignore[lock-discipline] no lock-guarded state: policy-free plumbing — work crosses threads only via the injected queues and stop Event; per-item state lives on WorkItem/Request (which declare their contracts)
 class DecodePool:
     """N daemon threads: decode-queue → (decode) → batch-queue.
 
@@ -102,6 +103,7 @@ class DecodePool:
                 self._out_q.put(item)
 
 
+# dsst: ignore[lock-discipline] no lock-guarded state: single batcher thread owns all its locals; items arrive via the queue and leave via run_batch
 class Batcher:
     """ONE thread: batch-queue → (coalesce) → ``run_batch``.
 

@@ -86,6 +86,12 @@ class ServerHandle:
     ``close()`` is idempotent and safe from any thread.
     """
 
+    # Lint contract (dsst lint, lock-discipline rule; enforced at
+    # runtime by dsst sanitize): close() races between the serve
+    # thread, Ctrl-C handlers, and embedding teardown — the
+    # exactly-once latch only under _lock.
+    _guarded_by_lock = ("_closed",)
+
     def __init__(self, server, thread, *, drain_timeout_s: float | None = None):
         self.server = server
         self.thread = thread

@@ -272,9 +272,13 @@ class ServingScheduler:
                 (time.monotonic() - req.t_admit) * 1000.0, 3
             )
             info["batch_fill"] = req.batch_fill
-        if req.error is not None:
-            raise req.error
-        return list(req.results)
+        # One locked snapshot instead of direct error/results reads: the
+        # deadline/stop exits reach here while a worker may still be
+        # settling the request (found by `dsst sanitize`, guarded-by).
+        error, results = req.outcome()
+        if error is not None:
+            raise error
+        return results
 
     # -- worker callbacks --------------------------------------------------
 

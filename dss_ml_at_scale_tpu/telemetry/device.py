@@ -51,6 +51,12 @@ class DeviceMonitor:
       can tell "no data" from "zero bytes".
     """
 
+    # Lint contract (dsst lint, lock-discipline rule; enforced at
+    # runtime by dsst sanitize): start()/stop() race from embedding
+    # code and the serve/train teardown paths — the sampler-thread
+    # handle only under _lock.
+    _guarded_by_lock = ("_thread",)
+
     def __init__(self, registry=None, *, interval_s: float = 1.0,
                  devices: Sequence | None = None):
         if registry is None:
@@ -81,6 +87,7 @@ class DeviceMonitor:
         self._samples = registry.counter(
             "device_monitor_samples_total", "DeviceMonitor sampling passes")
         self._stop = threading.Event()
+        self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
 
     @staticmethod
@@ -126,20 +133,33 @@ class DeviceMonitor:
                 pass
 
     def start(self) -> "DeviceMonitor":
-        if self._thread is not None and self._thread.is_alive():
-            return self
-        self._stop.clear()
-        self.sample()  # one immediate sample so gauges exist right away
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name="device-monitor")
-        self._thread.start()
+        # The whole check-then-spawn under _lock: two concurrent
+        # start() calls used to both see no live thread and spawn two
+        # sampler loops (and a stop() racing a start() could join a
+        # thread the start was about to replace) — the check-then-act
+        # shape the lock-discipline/sanitizer tier exists to catch.
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+            self.sample()  # one immediate sample so gauges exist right away
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="device-monitor")
+            self._thread.start()
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=timeout)
-            self._thread = None
+        # The event is set INSIDE the lock: set-before-lock left a
+        # window where a racing start() could observe the dead thread,
+        # clear the event, and spawn a sampler this stop() then joined
+        # without ever signalling — a loop running forever with
+        # _thread=None. Ordered under the lock, every sampler swapped
+        # out below has seen its stop signal.
+        with self._lock:
+            self._stop.set()
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=timeout)
 
     def __enter__(self) -> "DeviceMonitor":
         return self.start()

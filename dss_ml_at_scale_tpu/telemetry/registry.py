@@ -78,7 +78,7 @@ class _CounterValue:
             self.value = 0.0
 
     def _sample(self) -> dict:
-        # dsst: ignore[lock-discipline] lock-free approximate read: render paths tolerate a torn float; never written here
+        # dsst: ignore[lock-discipline,guarded-by] lock-free approximate read: render paths tolerate a torn float; never written here
         return {"value": self.value}
 
 
@@ -109,7 +109,7 @@ class _GaugeValue:
             self.value = 0.0
 
     def _sample(self) -> dict:
-        # dsst: ignore[lock-discipline] lock-free approximate read: render paths tolerate a torn float; never written here
+        # dsst: ignore[lock-discipline,guarded-by] lock-free approximate read: render paths tolerate a torn float; never written here
         return {"value": self.value}
 
 

@@ -25,6 +25,13 @@ if ! JAX_PLATFORMS=cpu timeout 900 python -m dss_ml_at_scale_tpu.config.cli audi
   echo "preflight FAILED: dsst audit dirty - refusing to spend the TPU claim"
   exit 1
 fi
+# Third tier: run the threaded subsystems under lock/thread
+# instrumentation — a lock-order inversion or guarded-by violation in
+# the feeder/serving/journal path must not ride a chip claim either.
+if ! JAX_PLATFORMS=cpu timeout 600 python -m dss_ml_at_scale_tpu.config.cli sanitize; then
+  echo "preflight FAILED: dsst sanitize dirty - refusing to spend the TPU claim"
+  exit 1
+fi
 
 echo "== probe =="
 timeout 150 python - <<'EOF'

@@ -31,10 +31,35 @@ class Pool:
 
 
 class Unannotated:
-    """No _guarded_by_lock declaration: not checked (opt-in contract)."""
+    """No _guarded_by_lock declaration: not checked (opt-in contract).
+    Owns no thread, so the thread-owner check stays quiet too."""
 
     def __init__(self):
         self.items = []
 
     def put(self, item):
         self.items.append(item)
+
+
+class DeclaredWorker:
+    """Thread owner WITH a contract: no finding."""
+
+    _guarded_by_lock = ("_jobs",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = []
+        self._thread = threading.Thread(target=self._drain)
+
+    def _drain(self):
+        with self._lock:
+            self._jobs.clear()
+
+
+# dsst: ignore[lock-discipline] queue/event channels only: fixture twin of the reasoned-suppression escape hatch
+class QueueOnlyWorker:
+    """Thread owner whose only crossing is a queue — suppressed with a
+    reason instead of declaring an empty contract."""
+
+    def __init__(self):
+        self._thread = threading.Thread(target=lambda: None)

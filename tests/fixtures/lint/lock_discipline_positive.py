@@ -1,6 +1,8 @@
-"""Fixture: 4 lock-discipline findings (2 class-attr, 2 module-global)."""
+"""Fixture: 7 lock-discipline findings (2 class-attr, 2 module-global,
+3 undeclared thread owners)."""
 
 import threading
+from threading import Thread as _SpawnAlias
 
 _CACHE: dict = {}
 _lock = threading.Lock()
@@ -39,3 +41,28 @@ class Pool:
 
     def close(self):
         self._closed = True          # same
+
+
+class UndeclaredWorker:
+    """Constructs a Thread with no _guarded_by_lock: a thread owner
+    invisible to the contract (and to the runtime sanitizer)."""
+
+    def __init__(self):
+        self.jobs = []
+        self._thread = threading.Thread(target=self.jobs.clear)
+
+
+class UndeclaredHandleOwner:
+    """Handed a thread in __init__, equally undeclared."""
+
+    def __init__(self, thread):
+        self.thread = thread
+        self.done = False
+
+
+class UndeclaredAliasWorker:
+    """`from threading import Thread as ...` must not evade the gate."""
+
+    def __init__(self):
+        self.jobs = []
+        self._thread = _SpawnAlias(target=self.jobs.clear)

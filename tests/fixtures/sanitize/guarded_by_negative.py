@@ -1,0 +1,35 @@
+"""Clean twin: the same sequencing, but the main thread's write holds
+the declaring lock — no finding."""
+
+import threading
+
+
+class Box:
+    _guarded_by_lock = ("state",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = 0
+
+    def locked_bump(self) -> None:
+        with self._lock:
+            self.state += 1
+
+
+def run() -> None:
+    box = Box()
+    acquired_once = threading.Event()
+    release = threading.Event()
+
+    def worker() -> None:
+        box.locked_bump()
+        acquired_once.set()
+        release.wait(10)
+        box.locked_bump()
+
+    t = threading.Thread(target=worker, name="sanfix-guarded-neg")
+    t.start()
+    acquired_once.wait(10)
+    box.locked_bump()  # disciplined: held
+    release.set()
+    t.join()

@@ -1,0 +1,41 @@
+"""Seeded guarded-by violation, fully event-sequenced: the worker
+thread acquires the declaring lock (and is held alive), then the main
+thread writes the guarded attribute off the lock — the exact
+check-then-act shape the contract forbids, without any actual
+corruption in the run."""
+
+import threading
+
+
+class Box:
+    _guarded_by_lock = ("state",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = 0
+
+    def locked_bump(self) -> None:
+        with self._lock:
+            self.state += 1
+
+    def racy_bump(self) -> None:
+        self.state += 1
+
+
+def run() -> None:
+    box = Box()
+    acquired_once = threading.Event()
+    release = threading.Event()
+
+    def worker() -> None:
+        box.locked_bump()
+        acquired_once.set()
+        release.wait(10)
+        box.locked_bump()
+
+    t = threading.Thread(target=worker, name="sanfix-guarded")
+    t.start()
+    acquired_once.wait(10)
+    box.racy_bump()  # off-lock write while the sharing thread is alive
+    release.set()
+    t.join()

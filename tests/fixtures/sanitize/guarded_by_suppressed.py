@@ -1,0 +1,41 @@
+"""Suppression-channel twin: the same off-lock write as the positive
+fixture, silenced by a reasoned ``# dsst: ignore[guarded-by]`` on the
+offending line — the finding must land in ``suppressed``, not
+``findings``."""
+
+import threading
+
+
+class Box:
+    _guarded_by_lock = ("state",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = 0
+
+    def locked_bump(self) -> None:
+        with self._lock:
+            self.state += 1
+
+    def racy_bump(self) -> None:
+        # dsst: ignore[guarded-by] fixture: approximate read-modify-write tolerated by design, proving the suppression channel
+        self.state += 1
+
+
+def run() -> None:
+    box = Box()
+    acquired_once = threading.Event()
+    release = threading.Event()
+
+    def worker() -> None:
+        box.locked_bump()
+        acquired_once.set()
+        release.wait(10)
+        box.locked_bump()
+
+    t = threading.Thread(target=worker, name="sanfix-guarded-sup")
+    t.start()
+    acquired_once.wait(10)
+    box.racy_bump()
+    release.set()
+    t.join()

@@ -1,0 +1,9 @@
+"""Clean twin: balanced with-block — nothing held at scope exit."""
+
+import threading
+
+
+def run() -> None:
+    lock = threading.Lock()
+    with lock:
+        pass

@@ -1,0 +1,10 @@
+"""Seeded leaked lock: an acquire with no release survives the scope.
+``run`` returns the lock so the test can release it afterwards."""
+
+import threading
+
+
+def run():
+    lock = threading.Lock()
+    lock.acquire()
+    return lock

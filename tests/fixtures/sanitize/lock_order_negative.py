@@ -1,0 +1,26 @@
+"""Clean twin of the AB/BA fixture: both threads honor one global
+order (A before B) — same locks, same threads, no cycle."""
+
+import threading
+
+
+def run() -> None:
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def first_pass() -> None:
+        with lock_a:
+            with lock_b:
+                pass
+
+    def second_pass() -> None:
+        with lock_a:
+            with lock_b:
+                pass
+
+    t1 = threading.Thread(target=first_pass, name="sanfix-ab-1")
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=second_pass, name="sanfix-ab-2")
+    t2.start()
+    t2.join()

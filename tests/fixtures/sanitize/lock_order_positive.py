@@ -1,0 +1,28 @@
+"""Seeded AB/BA lock-order cycle: two threads acquire the same two
+locks in opposite orders — sequentially, so nothing deadlocks, but the
+acquisition-order graph gains the A→B and B→A edges the sanitizer must
+report as a potential deadlock with both stacks."""
+
+import threading
+
+
+def run() -> None:
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def a_then_b() -> None:
+        with lock_a:
+            with lock_b:
+                pass
+
+    def b_then_a() -> None:
+        with lock_b:
+            with lock_a:
+                pass
+
+    t1 = threading.Thread(target=a_then_b, name="sanfix-ab")
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=b_then_a, name="sanfix-ba")
+    t2.start()
+    t2.join()

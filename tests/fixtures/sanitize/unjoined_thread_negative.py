@@ -1,0 +1,14 @@
+"""Clean twin: the worker is joined before ``run`` returns."""
+
+import threading
+
+
+def run() -> None:
+    release = threading.Event()
+    t = threading.Thread(
+        target=release.wait, args=(30,), name="sanfix-joined",
+        daemon=True,
+    )
+    t.start()
+    release.set()
+    t.join(10)

@@ -1,0 +1,17 @@
+"""Seeded unjoined thread: ``run`` returns while its worker is still
+alive (parked on an event), so the sanitize scope exits over a live
+thread. ``run`` returns the release event so the test can let the
+worker finish after the assertion — the fixture must not leak beyond
+the test."""
+
+import threading
+
+
+def run() -> threading.Event:
+    release = threading.Event()
+    t = threading.Thread(
+        target=release.wait, args=(30,), name="sanfix-unjoined",
+        daemon=True,
+    )
+    t.start()
+    return release

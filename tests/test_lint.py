@@ -131,7 +131,7 @@ RULES = {
     "trace_safety": (lambda: TraceSafetyChecker(), 5),
     "retrace_hazard": (lambda: RetraceHazardChecker(), 5),
     "host_sync": (lambda: HostSyncChecker(), 5),
-    "lock_discipline": (lambda: LockDisciplineChecker(), 4),
+    "lock_discipline": (lambda: LockDisciplineChecker(), 7),
     "telemetry_registry_pos": (
         lambda: TelemetryRegistryChecker(
             known={"requests_total": "counter", "dead_gauge": "gauge"}

@@ -1,0 +1,403 @@
+"""Tier-1 face of the ``dsst sanitize`` runtime thread sanitizer.
+
+Mirrors ``test_lint.py``/``test_audit.py``:
+
+- **the real gate**: every named workload (the threaded tier-1
+  subsystems — feeder, serving scheduler, worker pool, crash-only
+  journal, trace handoffs) runs armed and must report ZERO unbaselined
+  findings and zero stale baseline entries;
+- **seeded fixture twins** under ``tests/fixtures/sanitize/`` prove
+  each rule bites (AB/BA cycle with both stacks, off-lock guarded
+  write, unjoined thread, leaked lock) and spares the clean twins;
+- **framework semantics**: source-comment suppressions (reason
+  mandatory), baseline add/expire, disarmed restoration (plain
+  ``threading`` objects, no descriptors);
+- **satellite regressions**: the DeviceMonitor start/stop race and the
+  Request settlement-read fix the sanitizer surfaced;
+- **chaos coexistence**: one SIGKILL chaos train cycle with the
+  sanitizer armed in every child (``DSST_SANITIZE=1``) still converges.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from dss_ml_at_scale_tpu.analysis.sanitize import (
+    DEFAULT_SANITIZE_BASELINE,
+    build_result,
+    run_workloads,
+    sanitize_scope,
+    workload_names,
+)
+from dss_ml_at_scale_tpu.analysis.sanitize import runtime as sanrt
+from dss_ml_at_scale_tpu.analysis.sanitize.report import update_baseline
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "sanitize"
+
+
+def _load_fixture(name: str):
+    """Import a fixture module under the ``sanfix_`` prefix the armed
+    scope instruments. Re-executed per call so each test sees fresh
+    module state."""
+    modname = f"sanfix_{name}"
+    spec = importlib.util.spec_from_file_location(
+        modname, FIXTURES / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run_fixture(name: str, tmp_path):
+    """(result, run() return value) for one fixture under a fresh scope,
+    judged against an empty baseline."""
+    mod = _load_fixture(name)
+    with sanitize_scope(extra_prefixes=("sanfix_",)) as scope:
+        ret = mod.run()
+    empty = tmp_path / "empty_baseline.json"
+    res = build_result(
+        scope, [name], baseline_path=empty, full_run=False,
+    )
+    return res, ret
+
+
+def _rules(res) -> list[str]:
+    return [f.rule for f in res.findings]
+
+
+# -- the real gate: the threaded subsystems are sanitizer-clean ---------------
+
+
+def test_gate_all_workloads_clean_against_baseline():
+    """The acceptance gate: a sanitizer-armed pass over every named
+    workload — the same thread families the threaded tier-1 suites
+    exercise — reports zero unbaselined findings and zero stale
+    baseline entries."""
+    names = workload_names()
+    with sanitize_scope() as scope:
+        run_workloads(names)
+    res = build_result(scope, names, full_run=True)
+    assert res.findings == [], "\n" + "\n".join(
+        f.text() for f in res.findings
+    )
+    assert res.stale_baseline == [], res.stale_baseline
+    # The pass must be a real pass: instrumentation actually saw locks.
+    assert res.stats["locks"] > 10
+
+
+def test_every_baseline_entry_has_a_reason():
+    from dss_ml_at_scale_tpu.analysis import load_baseline
+
+    for key, entry in load_baseline(DEFAULT_SANITIZE_BASELINE).items():
+        assert str(entry.get("reason", "")).strip(), (
+            f"baseline entry {key} has no reason"
+        )
+
+
+# -- seeded fixture twins -----------------------------------------------------
+
+
+def test_lock_order_cycle_detected_with_both_stacks(tmp_path):
+    res, _ = _run_fixture("lock_order_positive", tmp_path)
+    cycles = [f for f in res.findings if f.rule == "lock-order"]
+    assert len(cycles) == 1, "\n".join(f.text() for f in res.findings)
+    f = cycles[0]
+    assert "lock_order_positive.py" in f.path
+    assert "conflicting orders" in f.message
+    # Both edges of the AB/BA cycle, each with held + acquired stacks.
+    assert len(f.stacks) == 4
+    text = f.text()
+    assert "with lock_a:" in text and "with lock_b:" in text
+    assert "sanfix-ab" in text and "sanfix-ba" in text
+
+
+def test_lock_order_clean_twin(tmp_path):
+    res, _ = _run_fixture("lock_order_negative", tmp_path)
+    assert res.findings == [], "\n".join(f.text() for f in res.findings)
+
+
+def test_guarded_by_off_lock_write_detected(tmp_path):
+    res, _ = _run_fixture("guarded_by_positive", tmp_path)
+    hits = [f for f in res.findings if f.rule == "guarded-by"]
+    assert len(hits) == 1, "\n".join(f.text() for f in res.findings)
+    f = hits[0]
+    assert "Box.state" in f.message
+    # `state += 1` is a read-then-write; the first access off the lock
+    # wins the (deduplicated) finding.
+    assert "off the lock" in f.message
+    # Offending stack AND the holder's acquisition stack.
+    labels = [label for label, _ in f.stacks]
+    assert any(lb.startswith("offending") for lb in labels)
+    assert any("lock last acquired by" in lb for lb in labels)
+    assert "racy_bump" in f.text()
+
+
+def test_guarded_by_clean_twin(tmp_path):
+    res, _ = _run_fixture("guarded_by_negative", tmp_path)
+    assert res.findings == [], "\n".join(f.text() for f in res.findings)
+
+
+def test_guarded_by_suppression_with_reason(tmp_path):
+    res, _ = _run_fixture("guarded_by_suppressed", tmp_path)
+    assert [f.rule for f in res.suppressed] == ["guarded-by"]
+    assert res.findings == [], "\n".join(f.text() for f in res.findings)
+
+
+def test_unjoined_thread_detected(tmp_path):
+    res, release = _run_fixture("unjoined_thread_positive", tmp_path)
+    try:
+        hits = [f for f in res.findings if f.rule == "unjoined-thread"]
+        assert len(hits) == 1, "\n".join(f.text() for f in res.findings)
+        assert "sanfix-unjoined" in hits[0].message
+    finally:
+        release.set()  # let the parked fixture thread finish
+
+
+def test_unjoined_thread_clean_twin(tmp_path):
+    res, _ = _run_fixture("unjoined_thread_negative", tmp_path)
+    assert res.findings == [], "\n".join(f.text() for f in res.findings)
+
+
+def test_leaked_lock_detected(tmp_path):
+    res, lock = _run_fixture("leaked_lock_positive", tmp_path)
+    try:
+        hits = [f for f in res.findings if f.rule == "leaked-lock"]
+        assert len(hits) == 1, "\n".join(f.text() for f in res.findings)
+        assert "still held" in hits[0].message
+    finally:
+        lock.release()
+
+
+def test_leaked_lock_clean_twin(tmp_path):
+    res, _ = _run_fixture("leaked_lock_negative", tmp_path)
+    assert res.findings == [], "\n".join(f.text() for f in res.findings)
+
+
+# -- baseline semantics -------------------------------------------------------
+
+
+def test_baseline_accepts_then_expires(tmp_path):
+    baseline = tmp_path / "SANITIZE_BASELINE.json"
+
+    # 1. The seeded cycle is a finding against an empty baseline.
+    mod = _load_fixture("lock_order_positive")
+    with sanitize_scope(extra_prefixes=("sanfix_",)) as scope:
+        mod.run()
+    res = build_result(scope, ["fixture"], baseline_path=baseline,
+                       full_run=True)
+    assert len(res.findings) == 1
+
+    # 2. Accepted with a mandatory reason -> subsequent run is clean.
+    update_baseline(baseline, res, "seeded fixture: accepted for the test")
+    mod = _load_fixture("lock_order_positive")
+    with sanitize_scope(extra_prefixes=("sanfix_",)) as scope:
+        mod.run()
+    res2 = build_result(scope, ["fixture"], baseline_path=baseline,
+                        full_run=True)
+    assert res2.findings == [] and len(res2.baselined) == 1
+    assert res2.ok
+
+    # 3. The finding stops reproducing (clean twin) -> the entry is
+    # stale ballast and FAILS a full run, but a subset run (which
+    # cannot prove absence) stays quiet.
+    mod = _load_fixture("lock_order_negative")
+    with sanitize_scope(extra_prefixes=("sanfix_",)) as scope:
+        mod.run()
+    res3 = build_result(scope, ["fixture"], baseline_path=baseline,
+                        full_run=True)
+    assert not res3.ok and len(res3.stale_baseline) == 1
+    res4 = build_result(scope, ["fixture"], baseline_path=baseline,
+                        full_run=False)
+    assert res4.ok
+
+
+def test_update_baseline_requires_reason(tmp_path):
+    from dss_ml_at_scale_tpu.analysis import LintUsageError
+
+    baseline = tmp_path / "b.json"
+    mod = _load_fixture("lock_order_positive")
+    with sanitize_scope(extra_prefixes=("sanfix_",)) as scope:
+        mod.run()
+    res = build_result(scope, ["fixture"], baseline_path=baseline,
+                       full_run=True)
+    with pytest.raises(LintUsageError, match="--reason"):
+        update_baseline(baseline, res, None)
+
+
+# -- disarmed = zero-cost -----------------------------------------------------
+
+
+def _skip_if_session_armed():
+    """The restoration tests assert the DISARMED state; under a
+    DSST_SANITIZE=1 session (conftest arms the whole run) there is no
+    disarmed state to observe until the session ends."""
+    from dss_ml_at_scale_tpu.analysis.sanitize import is_armed
+
+    if is_armed():
+        pytest.skip("sanitizer armed for the whole session")
+
+
+def test_disarmed_restores_plain_threading_objects():
+    from dss_ml_at_scale_tpu.telemetry.registry import _CounterValue
+
+    _skip_if_session_armed()
+
+    orig_value_descr = _CounterValue.__dict__["value"]
+    assert threading.Lock is sanrt._REAL_LOCK
+    with sanitize_scope():
+        assert threading.Lock is not sanrt._REAL_LOCK
+        assert threading.Thread is not sanrt._REAL_THREAD
+        # guarded descriptors installed over the declared classes
+        assert isinstance(
+            _CounterValue.__dict__["value"], sanrt._GuardedAttr
+        )
+    # Fully restored: plain threading factories, original descriptors.
+    assert threading.Lock is sanrt._REAL_LOCK
+    assert threading.RLock is sanrt._REAL_RLOCK
+    assert threading.Condition is sanrt._REAL_CONDITION
+    assert threading.Thread is sanrt._REAL_THREAD
+    assert _CounterValue.__dict__["value"] is orig_value_descr
+
+
+def test_disarmed_lock_creation_is_raw():
+    _skip_if_session_armed()
+    lock = threading.Lock()
+    assert type(lock).__module__ == "_thread"
+
+
+def test_nested_scopes_refcount():
+    _skip_if_session_armed()
+    with sanitize_scope():
+        patched = threading.Lock
+        with sanitize_scope():
+            assert threading.Lock is patched
+        # inner exit must NOT disarm the outer scope
+        assert threading.Lock is patched
+    assert threading.Lock is sanrt._REAL_LOCK
+
+
+# -- satellite regressions (races the sanitizer tier surfaced) ----------------
+
+
+def test_device_monitor_concurrent_start_spawns_one_thread():
+    """Regression: two concurrent ``start()`` calls used to both pass
+    the liveness check and spawn two sampler loops."""
+    from dss_ml_at_scale_tpu.telemetry.device import DeviceMonitor
+    from dss_ml_at_scale_tpu.telemetry.registry import MetricsRegistry
+
+    mon = DeviceMonitor(MetricsRegistry(), interval_s=60.0, devices=[])
+    gate = threading.Event()
+
+    def racer():
+        gate.wait(5)
+        mon.start()
+
+    racers = [threading.Thread(target=racer) for _ in range(8)]
+    for t in racers:
+        t.start()
+    gate.set()
+    for t in racers:
+        t.join(10)
+    monitors = [
+        t for t in threading.enumerate()
+        if t.name == "device-monitor" and t.is_alive()
+    ]
+    try:
+        assert len(monitors) == 1, monitors
+    finally:
+        mon.stop()
+    assert not any(t.is_alive() for t in monitors)
+    # stop() then start() again still works (the handle was cleared
+    # under the lock, not left dangling).
+    mon.start()
+    mon.stop()
+
+
+def test_request_outcome_snapshots_under_lock():
+    """Regression: submit() read ``error``/``results`` directly off the
+    lock after wait(); ``outcome()`` is the locked snapshot every exit
+    path (settled, deadline, stop) now shares."""
+    from dss_ml_at_scale_tpu.serving.admission import DeadlineExceeded, Request
+
+    req = Request(2)
+    req.complete_item(0, {"score": 1.0})
+    req.complete_item(1, {"score": 2.0})
+    error, results = req.outcome()
+    assert error is None and [r["score"] for r in results] == [1.0, 2.0]
+
+    req2 = Request(1)
+    assert req2.fail(DeadlineExceeded("late"))
+    error, results = req2.outcome()
+    assert isinstance(error, DeadlineExceeded) and results == [None]
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def _cli(argv: list[str]) -> int:
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    return main(argv)
+
+
+def test_cli_list_workloads(capsys):
+    assert _cli(["sanitize", "--list-workloads"]) == 0
+    out = capsys.readouterr().out
+    for name in workload_names():
+        assert name in out
+
+
+def test_cli_single_workload_json(capsys):
+    assert _cli(["sanitize", "--workloads", "workers", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == 1
+    assert doc["workloads"] == ["workers"]
+    assert doc["ok"] is True
+    assert doc["stats"]["locks"] > 0
+
+
+def test_cli_unknown_workload_is_usage_error(capsys):
+    assert _cli(["sanitize", "--workloads", "nope"]) == 2
+    assert "unknown workload" in capsys.readouterr().err
+
+
+def test_cli_subset_update_baseline_refused(capsys):
+    assert _cli([
+        "sanitize", "--workloads", "workers", "--update-baseline",
+        "--reason", "x",
+    ]) == 2
+    assert "full workload set" in capsys.readouterr().err
+
+
+# -- chaos coexistence --------------------------------------------------------
+
+
+def test_chaos_train_cycle_with_sanitizer_armed(tmp_path, monkeypatch):
+    """One SIGKILL chaos train cycle (the deterministic fs.* power-cut
+    inside the manifest window) with DSST_SANITIZE=1 exported to every
+    child: instrumentation must coexist with the crash-only runtime —
+    the soak still converges to the uninterrupted run's exact params."""
+    from dss_ml_at_scale_tpu.resilience.chaos import ChaosConfig, run_chaos
+
+    monkeypatch.setenv("DSST_SANITIZE", "1")
+    report = run_chaos(ChaosConfig(
+        workdir=str(tmp_path / "soak"), cycles=1, seed=3,
+        kill_min_s=1.0, kill_max_s=3.0, epochs=2,
+        rows=32, batch_size=16, image_size=32, timeout_s=240.0,
+    ))
+    problems = {
+        name: res for name, res in report["invariants"].items()
+        if not res.get("ok")
+    }
+    assert report["ok"], json.dumps(problems, indent=1)
+    assert report["kills_delivered"] >= 1
+    assert report["invariants"]["params_bitwise_equal"]["chaos"][
+        "digest"
+    ] == report["invariants"]["params_bitwise_equal"]["ref"]["digest"]

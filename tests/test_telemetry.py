@@ -499,6 +499,13 @@ def test_telemetry_cli_table_json_and_perfetto(tmp_path, capsys):
 def test_per_step_instrumentation_under_50us():
     """The Trainer's per-step registry work (two histogram observes, a
     counter probe path, a gauge set) must stay under 50 µs on CPU."""
+    from dss_ml_at_scale_tpu.analysis.sanitize import is_armed
+
+    if is_armed():
+        # A DSST_SANITIZE=1 session wraps every lock acquire with
+        # bookkeeping — the budget below is the PRODUCTION (disarmed)
+        # contract, and bench.py measures the armed overhead instead.
+        pytest.skip("sanitizer armed: per-op budget is a disarmed contract")
     r = MetricsRegistry()
     step_hist = r.histogram("step_s")
     wait_hist = r.histogram("wait_s")

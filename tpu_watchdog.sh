@@ -38,7 +38,12 @@ if ! JAX_PLATFORMS=cpu timeout 900 python -m dss_ml_at_scale_tpu.config.cli \
   echo "$(date -u +%H:%M:%S) preflight FAILED: dsst audit dirty - watchdog refusing to arm" >> tpu_watchdog.log
   exit 1
 fi
-echo "$(date -u +%H:%M:%S) preflight clean: lint + audit" >> tpu_watchdog.log
+if ! JAX_PLATFORMS=cpu timeout 600 python -m dss_ml_at_scale_tpu.config.cli \
+    sanitize >> tpu_watchdog.log 2>&1; then
+  echo "$(date -u +%H:%M:%S) preflight FAILED: dsst sanitize dirty - watchdog refusing to arm" >> tpu_watchdog.log
+  exit 1
+fi
+echo "$(date -u +%H:%M:%S) preflight clean: lint + audit + sanitize" >> tpu_watchdog.log
 N=0
 while true; do
   if [ "$(date -u +%s)" -ge "$DEADLINE_EPOCH" ]; then
