@@ -77,18 +77,23 @@ _PARTIAL_ENV = "DSST_BENCH_PARTIAL"  # child progress file (resume + salvage)
 def _save_partial(result: dict) -> None:
     """Checkpoint child progress so a watchdog kill loses nothing.
 
-    Written atomically after every completed stage; the parent salvages
-    it when an attempt times out, and the next attempt resumes from it
-    (observed need: a degraded tunnel where each stage is minutes, so
-    two 900 s attempts that each restart from zero never finish)."""
+    Published durably after every completed stage via the package's
+    crash-only primitive (fsync'd tmp → atomic rename → dir fsync — the
+    same ``resilience.durability`` publish every other salvage point
+    uses; this file hand-rolled a weaker rename before the bench/
+    framework subsumed partial salvage); the parent salvages it when an
+    attempt times out, and the next attempt resumes from it (observed
+    need: a degraded tunnel where each stage is minutes, so two 900 s
+    attempts that each restart from zero never finish)."""
     path = os.environ.get(_PARTIAL_ENV)
     if not path:
         return
     try:
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(result, f)
-        os.replace(tmp, path)
+        from dss_ml_at_scale_tpu.resilience.durability import (
+            durable_write_json,
+        )
+
+        durable_write_json(path, result, kind="bench")
     except OSError:
         pass
 

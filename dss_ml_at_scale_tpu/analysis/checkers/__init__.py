@@ -1,11 +1,12 @@
 """Checker plugins. Importing this package registers every rule.
 
 Three migrated from the ad-hoc ``scripts/check_*.py`` lints (thin shims
-remain at the old paths), seven new JAX/runtime-aware rules.
+remain at the old paths), the rest new JAX/runtime-aware rules.
 """
 
 from . import (  # noqa: F401
     bare_except,
+    bench_registry,
     durable_write,
     fault_sites,
     host_sync,

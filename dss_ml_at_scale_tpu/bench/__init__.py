@@ -1,0 +1,50 @@
+"""Performance-observability subsystem: ``dsst bench``.
+
+The fourth analysis tier: a scenario registry with noise-aware
+measurements (median/MAD over isolated-child repetitions), a committed
+environment-fingerprinted ``BENCH_BASELINE.json`` with the same
+add/expire/reopen semantics as LINT/AUDIT/SANITIZE, achieved-FLOPs/s
+gauges priced against the audit-pinned cost budgets, and a profile
+mode that merges the flight-recorder host spans with a
+``jax.profiler`` device trace into one Perfetto timeline.
+"""
+
+from .core import (
+    BENCH_SCHEMA_VERSION,
+    DEFAULT_BENCH_BASELINE,
+    BenchResult,
+    BenchUsageError,
+    Metric,
+    Scenario,
+    environment_fingerprint,
+    fingerprint_key,
+    get_scenario,
+    load_bench_baseline,
+    measure_scenario,
+    register_scenario,
+    resolve_selection,
+    run_bench,
+    scenario_catalog,
+    scenario_names,
+    write_bench_baseline,
+)
+
+__all__ = [
+    "BENCH_SCHEMA_VERSION",
+    "BenchResult",
+    "BenchUsageError",
+    "DEFAULT_BENCH_BASELINE",
+    "Metric",
+    "Scenario",
+    "environment_fingerprint",
+    "fingerprint_key",
+    "get_scenario",
+    "load_bench_baseline",
+    "measure_scenario",
+    "register_scenario",
+    "resolve_selection",
+    "run_bench",
+    "scenario_catalog",
+    "scenario_names",
+    "write_bench_baseline",
+]

@@ -1,0 +1,163 @@
+"""``dsst bench profile``: host spans + device trace on ONE timeline.
+
+``jax.profiler`` answers "what did the device do" (XLA ops, per-core
+lanes); the flight recorder answers "what did the runtime do" (feeder
+handoffs, step dispatch, with cross-thread flow arrows). Debugging an
+input stall or a dispatch gap needs both on the SAME timeline — so this
+module runs one scenario under both recorders and merges the results
+into a single Perfetto ``trace_event`` file:
+
+- the flight-recorder tail renders through
+  :func:`~dss_ml_at_scale_tpu.telemetry.spans.to_perfetto` — lanes
+  named after runtime threads, ``ph s/f`` flow arrows intact;
+- the ``jax.profiler`` trace's events ride along with their pids
+  offset into a dedicated range (no collision with host pids) and
+  their clock aligned to wall time when the profiler emitted
+  trace-relative timestamps.
+
+When the profiled scenario declares an audited ``entrypoint``, its
+``train_step`` spans are also priced into the achieved-FLOPs/s gauges
+(:mod:`.mfu`) — the profile run doubles as a utilization reading.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import tempfile
+import time
+from pathlib import Path
+
+# Profiler pids land here so device lanes can never collide with host
+# process pids in the merged file.
+PROFILER_PID_OFFSET = 1 << 20
+
+# Timestamps above this are epoch-anchored microseconds (~year 2001+);
+# below, the profiler wrote trace-relative time and needs aligning.
+_EPOCH_US_FLOOR = 1e12
+
+
+def _load_profiler_events(trace_dir: str) -> list[dict]:
+    files = glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    events: list[dict] = []
+    for f in sorted(files):
+        try:
+            with gzip.open(f, "rt") as fh:
+                trace = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        evs = trace.get("traceEvents", [])
+        if isinstance(evs, list):
+            events.extend(e for e in evs if isinstance(e, dict))
+    return events
+
+
+def _merge_profiler_events(events: list[dict], wall_start_us: float,
+                           min_dur_us: float) -> tuple[list[dict], int]:
+    """Offset pids into the profiler range, align the clock, and floor
+    event durations. The CPU/TPU runtimes emit hundreds of thousands of
+    sub-microsecond TraceMes per second of wall time — a merged file
+    keeping them all is ~100MB and chokes the viewer, so complete
+    events shorter than ``min_dur_us`` are dropped and COUNTED (the
+    report and the CLI both surface the number: a silent cap would
+    read as full coverage). Metadata rows always survive."""
+    xs = [e.get("ts") for e in events
+          if e.get("ph") in ("X", "B", "E") and e.get("ts") is not None]
+    shift = 0.0
+    if xs and min(xs) < _EPOCH_US_FLOOR:
+        shift = wall_start_us - min(xs)
+    out = []
+    dropped = 0
+    for e in events:
+        if (e.get("ph") == "X" and min_dur_us > 0
+                and float(e.get("dur", 0.0) or 0.0) < min_dur_us):
+            dropped += 1
+            continue
+        e2 = dict(e)
+        try:
+            # pid-less events (clock-sync markers) still get a pid so
+            # every profiler event lands in the offset lane range.
+            e2["pid"] = int(e2.get("pid", 0)) + PROFILER_PID_OFFSET
+        except (TypeError, ValueError):
+            e2["pid"] = PROFILER_PID_OFFSET
+        if shift and e2.get("ts") is not None:
+            try:
+                e2["ts"] = float(e2["ts"]) + shift
+            except (TypeError, ValueError):
+                pass
+        if e2.get("ph") == "M" and e2.get("name") == "process_name":
+            args = dict(e2.get("args", {}))
+            args["name"] = f"jax: {args.get('name', '?')}"
+            e2["args"] = args
+        out.append(e2)
+    return out, dropped
+
+
+def profile_scenario(name: str, out_path: str | os.PathLike, *,
+                     repetitions: int = 1,
+                     min_profiler_dur_us: float = 5.0) -> dict:
+    """Run ``name`` once in-process under the flight recorder AND a
+    ``jax.profiler`` trace; write ONE merged Perfetto file. Returns
+    ``{"out", "spans", "flows", "profiler_events",
+    "profiler_events_dropped", "mfu"}``. ``min_profiler_dur_us=0``
+    keeps every profiler event."""
+    import jax
+
+    from ..telemetry import flightrec
+    from ..telemetry.spans import load_span_jsonl, to_perfetto
+    from . import mfu
+    from .core import get_scenario, measure_scenario
+
+    sc = get_scenario(name)
+    out_path = Path(out_path)
+    with tempfile.TemporaryDirectory(prefix="dsst_bench_prof_") as tmpdir:
+        tail = os.path.join(tmpdir, "flightrec.jsonl")
+        trace_dir = os.path.join(tmpdir, "jax_trace")
+        flightrec.enable(tail)
+        wall_start = time.time()
+        jax.profiler.start_trace(trace_dir)
+        try:
+            # warmup=0: a profile wants the trace, not a gated number —
+            # tracing the warmup repetition would double the (already
+            # enormous) profiler event volume for no fidelity.
+            measure_scenario(sc, repetitions=repetitions, warmup=0, env={})
+        finally:
+            jax.profiler.stop_trace()
+            flightrec.disable(tail)
+
+        spans = load_span_jsonl(tail)
+        merged = to_perfetto(spans)
+        flows = sum(
+            1 for e in merged["traceEvents"] if e.get("ph") in ("s", "f")
+        )
+        profiler_events, dropped = _merge_profiler_events(
+            _load_profiler_events(trace_dir), wall_start * 1e6,
+            min_profiler_dur_us,
+        )
+        merged["traceEvents"].extend(profiler_events)
+
+        block = None
+        if sc.entrypoint:
+            # device_kind makes the utilization-vs-peak half of the
+            # gauge reachable on accelerators — the run_bench path
+            # passes the same fingerprint field.
+            block = mfu.publish_from_trace(
+                tail, sc.entrypoint,
+                device_kind=jax.devices()[0].device_kind,
+            )
+
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(merged, f)
+    return {
+        "out": str(out_path),
+        "spans": len(spans),
+        "flows": flows,
+        "profiler_events": len(profiler_events),
+        "profiler_events_dropped": dropped,
+        "mfu": block,
+    }

@@ -1,0 +1,557 @@
+"""The registered scenarios: bench.py's stages, decomposed and gated.
+
+Each scenario isolates one seam of the system the ROADMAP's scale items
+need proven numbers for — decode, reader, device feeding, the compiled
+step, the observability layers' own overhead, and serving load. Where a
+scenario executes a compiled program it builds it through the **audit
+entrypoint registry** (the same builders ``dsst audit`` certifies), so
+the measured program and the pinned cost budget describe identical XLA
+— that is what makes the achieved-FLOPs/s gauges honest.
+
+Declarations here are reconciled against
+``telemetry.catalog.KNOWN_BENCH_METRICS`` in both directions by the
+``bench-registry`` lint rule: scenario/metric names must be literal.
+
+The ``feeder_e2e`` scenario self-verifies: its measured wall time is
+cross-checked against the flight-recorder attribution buckets (the
+SAME ``telemetry.catalog.SPAN_ATTRIBUTION`` mapping ``dsst trace
+attribution`` uses), and an unexplained gap fails the scenario — a
+harness whose own spans stop covering its loop must say so, not emit
+numbers nobody can attribute.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+
+from .core import Metric, Scenario, register_scenario
+
+# Geometry shared by the decode/reader stages: tiny sources so tier-1
+# children finish in seconds; throughput at this size is a *relative*
+# gate (same work every run), not an absolute claim.
+_SRC_SIZE = 32
+_CROP = 32
+_N_IMAGES = 96
+_BATCH = 16
+
+
+def _tiny_jpegs(n: int, size: int, seed: int = 0) -> list[bytes]:
+    """Blocky low-frequency JPEGs: realistic decode entropy (pure noise
+    inflates decode cost; flat color deflates it) — bench.py's recipe."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        blocks = rng.uniform(0, 255, (8, 8, 3))
+        img = np.kron(blocks, np.ones((size // 8, size // 8, 1)))
+        buf = io.BytesIO()
+        Image.fromarray(img.astype(np.uint8)).save(
+            buf, format="JPEG", quality=85
+        )
+        out.append(buf.getvalue())
+    return out
+
+
+def _transform_spec():
+    from ..data.transform import imagenet_transform_spec
+
+    return imagenet_transform_spec(
+        resize=_CROP + _CROP // 8, crop=_CROP, output_dtype="uint8"
+    )
+
+
+# -- decode -------------------------------------------------------------------
+
+
+def _decode_setup():
+    jpegs = _tiny_jpegs(_N_IMAGES, _SRC_SIZE)
+    spec = _transform_spec()
+    probe = {
+        "content": jpegs,
+        "label_index": [0] * len(jpegs),
+    }
+    spec(dict(probe))  # warm the decode path (thread pool, caches)
+    return {"spec": spec, "probe": probe}
+
+
+def _decode_measure(ctx) -> dict:
+    t0 = time.perf_counter()
+    ctx["spec"](dict(ctx["probe"]))
+    dt = time.perf_counter() - t0
+    return {"decode_images_per_sec": len(ctx["probe"]["content"]) / dt}
+
+
+register_scenario(Scenario(
+    name="decode",
+    description="JPEG decode + transform throughput, raw bytes in, "
+    "host batch out (no reader, no device)",
+    tier="tier1",
+    metrics=(
+        Metric("decode_images_per_sec", "images/sec", "higher",
+               floor=0.6),
+    ),
+    setup=_decode_setup,
+    measure=_decode_measure,
+    repetitions=5,
+    timeout_s=120.0,
+))
+
+
+# -- reader -------------------------------------------------------------------
+
+
+def _reader_setup():
+    import pyarrow as pa
+
+    from ..data import write_delta
+
+    tmpdir = tempfile.mkdtemp(prefix="dsst_bench_reader_")
+    jpegs = _tiny_jpegs(_N_IMAGES, _SRC_SIZE)
+    table = pa.table({
+        "content": pa.array(jpegs, type=pa.binary()),
+        "label_index": pa.array([i % 7 for i in range(len(jpegs))],
+                                type=pa.int64()),
+    })
+    path = os.path.join(tmpdir, "bench_imagenet")
+    write_delta(table, path, max_rows_per_file=max(16, len(jpegs) // 4))
+    return {"tmpdir": tmpdir, "path": path, "spec": _transform_spec()}
+
+
+def _reader_measure(ctx) -> dict:
+    from ..data import batch_loader
+
+    n_batches = 4
+    with batch_loader(
+        ctx["path"],
+        batch_size=_BATCH,
+        num_epochs=None,
+        workers_count=2,
+        results_queue_size=8,
+        transform_spec=ctx["spec"],
+    ) as reader:
+        it = iter(reader)
+        next(it)  # warm: open files, fill the pool
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            next(it)
+        dt = time.perf_counter() - t0
+    return {"reader_images_per_sec": _BATCH * n_batches / dt}
+
+
+register_scenario(Scenario(
+    name="reader",
+    description="Delta table -> sharded reader -> decode pool -> host "
+    "batches (no device)",
+    tier="tier1",
+    metrics=(
+        Metric("reader_images_per_sec", "images/sec", "higher",
+               floor=0.6),
+    ),
+    setup=_reader_setup,
+    teardown=lambda ctx: shutil.rmtree(ctx["tmpdir"], ignore_errors=True),
+    repetitions=3,
+    measure=_reader_measure,
+    timeout_s=240.0,
+))
+
+
+# -- compute (the audited classifier train step) ------------------------------
+
+
+def _audited_train_step(mesh=None):
+    """(compiled, state, batch): the EXACT program ``dsst audit`` pins
+    for ``train_step.classifier``, built through the audit registry's
+    builder on the same 8-device abstract mesh and AOT-compiled — the
+    ONE builder both the compute and feeder_e2e scenarios share, so
+    they can never measure different programs while citing one pin."""
+    from ..analysis.audit.core import default_audit_mesh
+    from ..analysis.audit.entrypoints import train_step_classifier
+
+    spec = train_step_classifier(
+        default_audit_mesh() if mesh is None else mesh
+    )
+    state, batch = spec.args
+    compiled = spec.jitted.lower(*spec.args).compile()
+    return compiled, state, batch
+
+
+def _compute_setup():
+    compiled, state, batch = _audited_train_step()
+    return {"compiled": compiled, "state": state, "batch": batch}
+
+
+def _compute_measure(ctx) -> dict:
+    steps = 10
+    state, batch = ctx["state"], ctx["batch"]
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = ctx["compiled"](state, batch)
+    float(metrics["train_loss"])
+    dt = time.perf_counter() - t0
+    ctx["state"] = state
+    sps = steps / dt
+    return {
+        "compute_steps_per_sec": sps,
+        "compute_images_per_sec": sps * batch["image"].shape[0],
+    }
+
+
+register_scenario(Scenario(
+    name="compute",
+    description="audited train_step.classifier program (8-device "
+    "abstract mesh) steps/sec — prices the audit-pinned FLOPs budget "
+    "into the achieved-FLOPs/s gauges",
+    tier="tier1",
+    metrics=(
+        Metric("compute_steps_per_sec", "steps/sec", "higher",
+               floor=0.6),
+        Metric("compute_images_per_sec", "images/sec", "higher",
+               gate=False),
+    ),
+    setup=_compute_setup,
+    measure=_compute_measure,
+    repetitions=3,
+    timeout_s=420.0,
+    needs_mesh=True,
+    entrypoint="train_step.classifier",
+    steps_metric="compute_steps_per_sec",
+))
+
+
+# -- feeder e2e (traced, self-verifying) --------------------------------------
+
+
+def _feeder_setup():
+    from ..analysis.audit.core import default_audit_mesh
+
+    mesh = default_audit_mesh()
+    compiled, state, batch = _audited_train_step(mesh)
+    # One throwaway call so the first measured repetition starts from a
+    # warm executable (the warmup repetition then covers feeder spin-up).
+    state, metrics = compiled(state, batch)
+    float(metrics["train_loss"])
+    return {
+        "mesh": mesh,
+        "compiled": compiled,
+        "state": state,
+        "tmpdir": tempfile.mkdtemp(prefix="dsst_bench_feeder_"),
+        "rep": 0,
+    }
+
+
+# Rows per synthetic host batch — MUST match the audited
+# train_step.classifier batch shape (the compiled program is
+# shape-specialized); also the numerator of e2e_images_per_sec.
+_E2E_ROWS = 16
+
+
+def _host_batches(n: int):
+    import numpy as np
+
+    for _ in range(n):
+        yield {
+            "image": np.zeros((_E2E_ROWS, 16, 16, 3), np.float32),
+            "label": np.zeros((_E2E_ROWS,), np.int32),
+        }
+
+
+def _attribution_buckets(tail_path, since: float) -> dict[str, float]:
+    """Seconds per attribution bucket over the tail's step-kind spans
+    opened after ``since`` — the same SPAN_ATTRIBUTION mapping ``dsst
+    trace attribution`` reads, so this cross-check and the CLI tool
+    cannot drift apart."""
+    from ..telemetry import flightrec
+    from ..telemetry.catalog import SPAN_ATTRIBUTION
+
+    complete, _opens = flightrec.reconstruct(
+        flightrec.read_events(tail_path)
+    )
+    buckets = {"data_wait": 0.0, "transfer": 0.0, "compute": 0.0,
+               "host": 0.0}
+    for e in complete:
+        if e.get("kind") != "step" or e.get("ts", 0.0) < since:
+            continue
+        buckets[SPAN_ATTRIBUTION.get(e.get("name"), "host")] += e.get(
+            "dur", 0.0
+        )
+    return buckets
+
+
+def _feeder_measure(ctx) -> dict:
+    from .. import telemetry
+    from ..data.prefetch import MeshFeeder
+    from ..telemetry import flightrec
+
+    steps = 8
+    ctx["rep"] += 1
+    state = ctx["state"]
+    # Record onto the recorder's existing tail when one is live (a
+    # tracked run, or `dsst bench profile` merging this very trace);
+    # otherwise scope a private tail for the cross-check. The `since`
+    # mark keeps the bucket read to THIS repetition either way.
+    rec = flightrec.get_recorder()
+    own_tail = None
+    tail = rec.path
+    if tail is None:
+        own_tail = os.path.join(ctx["tmpdir"], f"tail{ctx['rep']}.jsonl")
+        tail = flightrec.enable(own_tail)
+    since = time.time()
+    try:
+        feeder = MeshFeeder(
+            _host_batches(steps), ctx["mesh"], depth=3, name="bench-e2e"
+        )
+        try:
+            stall = 0.0
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                s0 = time.perf_counter()
+                batch, _prov = next(feeder)
+                stall += time.perf_counter() - s0
+                with feeder.last_handoff.activate(), \
+                        telemetry.span("train_step"):
+                    state, metrics = ctx["compiled"](state, batch)
+            float(metrics["train_loss"])
+            wall = time.perf_counter() - t0
+        finally:
+            feeder.close()
+    finally:
+        if own_tail is not None:
+            flightrec.disable(own_tail)
+    ctx["state"] = state
+
+    buckets = _attribution_buckets(tail, since)
+    traced = sum(buckets.values())
+    unexplained = max(0.0, wall - traced) / wall if wall > 0 else 0.0
+    if unexplained > 0.5:
+        # The harness's self-verification: if the spans the attribution
+        # tool buckets stop covering this loop (a renamed span, a broken
+        # handoff), the number is unattributable — fail loudly instead
+        # of shipping it.
+        raise RuntimeError(
+            f"e2e wall time unexplained by trace attribution: "
+            f"{unexplained:.0%} of {wall*1e3:.1f}ms has no span "
+            f"(buckets: { {k: round(v*1e3, 1) for k, v in buckets.items()} } "
+            "ms) — feeder/step spans or the step handoff broke"
+        )
+    return {
+        "e2e_images_per_sec": _E2E_ROWS * steps / wall,
+        "e2e_steps_per_sec": steps / wall,
+        "feeder_stall_fraction": stall / wall if wall > 0 else 0.0,
+        "e2e_unexplained_fraction": unexplained,
+    }
+
+
+register_scenario(Scenario(
+    name="feeder_e2e",
+    description="traced MeshFeeder -> audited train step loop; wall "
+    "time cross-checked against flight-recorder attribution buckets "
+    "(fails on unexplained gap)",
+    tier="slow",
+    metrics=(
+        Metric("e2e_images_per_sec", "images/sec", "higher",
+               floor=0.6),
+        Metric("e2e_steps_per_sec", "steps/sec", "higher", gate=False),
+        Metric("feeder_stall_fraction", "fraction", "lower", gate=False),
+        Metric("e2e_unexplained_fraction", "fraction", "lower",
+               gate=False),
+    ),
+    setup=_feeder_setup,
+    teardown=lambda ctx: shutil.rmtree(ctx["tmpdir"], ignore_errors=True),
+    measure=_feeder_measure,
+    repetitions=3,
+    timeout_s=420.0,
+    needs_mesh=True,
+    entrypoint="train_step.classifier",
+))
+
+
+# -- recorder overhead --------------------------------------------------------
+
+_EMIT_EVENTS = 1500
+
+
+def _recorder_setup():
+    return {"tmpdir": tempfile.mkdtemp(prefix="dsst_bench_rec_"), "rep": 0}
+
+
+def _recorder_measure(ctx) -> dict:
+    from ..telemetry import flightrec
+
+    rec = flightrec.get_recorder()
+    ctx["rep"] += 1
+    # The scenario must OWN the recorder target for both halves of the
+    # comparison: a live recorder (a tracked run, `dsst bench profile`)
+    # would otherwise absorb the ring loop's synthetic events into its
+    # tail — measuring tail cost where ring cost was claimed — and the
+    # scoped disable below would silently switch that recorder off.
+    # Park the previous target and restore it on the way out.
+    prev = rec.path
+    if prev is not None:
+        flightrec.disable(prev)
+
+    def _event(i: int) -> dict:
+        return {
+            "ph": "X", "name": "train_step", "ts": time.time(),
+            "dur": 0.001, "pid": os.getpid(), "tid": 1,
+            "thread": "bench", "span": f"{i:08x}",
+        }
+
+    tail = os.path.join(ctx["tmpdir"], f"tail{ctx['rep']}.jsonl")
+    try:
+        t0 = time.perf_counter()
+        for i in range(_EMIT_EVENTS):
+            rec.emit(_event(i))
+        ring_dt = time.perf_counter() - t0
+
+        flightrec.enable(tail)
+        try:
+            t0 = time.perf_counter()
+            for i in range(_EMIT_EVENTS):
+                rec.emit(_event(i))
+            tail_dt = time.perf_counter() - t0
+        finally:
+            flightrec.disable(tail)
+    finally:
+        if prev is not None:
+            flightrec.enable(prev)
+    tail_bytes = os.path.getsize(tail)
+    return {
+        "recorder_emit_ring_us": ring_dt / _EMIT_EVENTS * 1e6,
+        "recorder_emit_tail_us": tail_dt / _EMIT_EVENTS * 1e6,
+        "recorder_tail_bytes_per_event": tail_bytes / _EMIT_EVENTS,
+    }
+
+
+register_scenario(Scenario(
+    name="recorder_overhead",
+    description="flight-recorder emit cost: in-memory ring vs "
+    "write-through JSONL tail, plus bytes per event",
+    tier="tier1",
+    metrics=(
+        Metric("recorder_emit_ring_us", "us/event", "lower", gate=False),
+        Metric("recorder_emit_tail_us", "us/event", "lower", gate=False),
+        # Bytes/event is deterministic for a fixed event shape — the one
+        # recorder metric a shared CI box can gate tightly: it catches
+        # event-payload bloat before every tail on every run grows.
+        Metric("recorder_tail_bytes_per_event", "bytes", "lower",
+               floor=0.25),
+    ),
+    setup=_recorder_setup,
+    teardown=lambda ctx: shutil.rmtree(ctx["tmpdir"], ignore_errors=True),
+    measure=_recorder_measure,
+    repetitions=5,
+    timeout_s=120.0,
+))
+
+
+# -- sanitizer overhead -------------------------------------------------------
+
+_ACQUIRES = 20_000
+
+
+def _lock_loop(lock, n: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with lock:
+            pass
+    return time.perf_counter() - t0
+
+
+def _sanitizer_measure(_ctx) -> dict:
+    import threading
+
+    from ..analysis.sanitize import sanitize_scope
+
+    plain_dt = _lock_loop(threading.Lock(), _ACQUIRES)
+    with sanitize_scope():
+        # Constructed INSIDE the armed scope: instrumentation covers
+        # locks created while armed (the dsst sanitize model).
+        armed_dt = _lock_loop(threading.Lock(), _ACQUIRES)
+    return {
+        "sanitizer_plain_acquire_us": plain_dt / _ACQUIRES * 1e6,
+        "sanitizer_armed_acquire_us": armed_dt / _ACQUIRES * 1e6,
+        "sanitizer_overhead_ratio": (
+            armed_dt / plain_dt if plain_dt > 0 else 0.0
+        ),
+    }
+
+
+register_scenario(Scenario(
+    name="sanitizer_overhead",
+    description="dsst sanitize interposition cost per uncontended lock "
+    "acquire, armed vs plain",
+    tier="tier1",
+    metrics=(
+        Metric("sanitizer_plain_acquire_us", "us/acquire", "lower",
+               gate=False),
+        Metric("sanitizer_armed_acquire_us", "us/acquire", "lower",
+               gate=False),
+        # The ratio cancels host speed; floor 1.5 tolerates scheduler
+        # noise while catching an interposition cost blow-up.
+        Metric("sanitizer_overhead_ratio", "x", "lower", floor=1.5),
+    ),
+    measure=_sanitizer_measure,
+    repetitions=5,
+    timeout_s=120.0,
+))
+
+
+# -- serving loadgen ----------------------------------------------------------
+
+
+def _serving_setup():
+    from . import loadgen
+
+    proc, port = loadgen.spawn_stub_server(
+        micro_batch=8, score_ms=5.0, batch_window_ms=5.0, queue_depth=64,
+    )
+    return {"proc": proc, "port": port}
+
+
+def _serving_teardown(ctx) -> None:
+    ctx["proc"].terminate()
+    ctx["proc"].wait(15)
+
+
+def _serving_measure(ctx) -> dict:
+    from . import loadgen
+
+    report = loadgen.run_load(
+        "127.0.0.1", ctx["port"], b"0", threads=8, duration_s=1.2,
+    )
+    fill = report["server"]["batch_fill"]["mean"]
+    return {
+        "serving_throughput_rps": report["throughput_rps"],
+        "serving_p50_ms": (report["latency_s"]["p50"] or 0.0) * 1e3,
+        "serving_p99_ms": (report["latency_s"]["p99"] or 0.0) * 1e3,
+        "serving_batch_fill_mean": fill if fill is not None else 0.0,
+        "_extra": {"loadgen": report},
+    }
+
+
+register_scenario(Scenario(
+    name="serving",
+    description="closed-loop loadgen vs the stub-scorer scheduler "
+    "subprocess over real sockets (admission, decode pool, "
+    "cross-request batching) — the BENCH_serving.json producer",
+    tier="tier1",
+    metrics=(
+        Metric("serving_throughput_rps", "req/sec", "higher",
+               floor=0.6),
+        Metric("serving_p50_ms", "ms", "lower", floor=0.6),
+        Metric("serving_p99_ms", "ms", "lower", gate=False),
+        Metric("serving_batch_fill_mean", "images", "higher", gate=False),
+    ),
+    setup=_serving_setup,
+    teardown=_serving_teardown,
+    measure=_serving_measure,
+    repetitions=3,
+    timeout_s=240.0,
+))

@@ -2859,19 +2859,17 @@ def _cmd_trace_export(args: argparse.Namespace) -> int:
     return 0
 
 
-# Attribution buckets: span name -> where a step's wall time went. The
-# names are held to telemetry.catalog.KNOWN_SPANS by the span-discipline
-# lint, so this mapping cannot silently rot.
-_ATTRIBUTION = {
-    "reader.next": "data_wait",
-    "feeder.place": "transfer",
-    "mesh.plan": "transfer",
-    "train_step": "compute",
-}
-
-
 def _cmd_trace_attribution(args: argparse.Namespace) -> int:
     from ..telemetry import flightrec
+
+    # Attribution buckets: span name -> where a step's wall time went.
+    # Sourced from telemetry.catalog.SPAN_ATTRIBUTION — the ONE mapping
+    # this command and the bench harness's e2e cross-check share (names
+    # held to KNOWN_SPANS by the span-discipline lint), so the two
+    # consumers cannot drift apart. Imported at command time: loading
+    # the telemetry package pulls jax, which every other subcommand's
+    # startup must not pay.
+    from ..telemetry.catalog import SPAN_ATTRIBUTION as _ATTRIBUTION
 
     path = _trace_source(args)
     if path is None:
@@ -2971,6 +2969,184 @@ def _cmd_trace_attribution(args: argparse.Namespace) -> int:
     return 0
 
 
+def register_bench(sub: argparse._SubParsersAction) -> None:
+    bn = sub.add_parser(
+        "bench",
+        help="performance regression harness (fourth analysis tier): "
+        "run registered scenarios in isolated children with "
+        "noise-aware repetitions and judge them against the "
+        "environment-fingerprinted BENCH_BASELINE.json; `dsst bench "
+        "profile <scenario>` merges flight-recorder spans with a "
+        "jax.profiler trace into one Perfetto timeline",
+    )
+    bn.add_argument(
+        "--scenarios", default=None, metavar="S1,S2",
+        help="comma-separated subset of scenarios (default: every "
+        "non-tpu scenario; see --list-scenarios)",
+    )
+    bn.add_argument(
+        "--tier", default=None, metavar="TIER",
+        help="run one tier (tier1 | slow | tpu) instead of naming "
+        "scenarios — tier1 is the CI smoke subset",
+    )
+    bn.add_argument(
+        "--repetitions", type=int, default=None, metavar="N",
+        help="override every selected scenario's repetition count",
+    )
+    bn.add_argument(
+        "--in-process", action="store_true",
+        help="measure inline instead of per-scenario child processes "
+        "(debugging; loses crash isolation)",
+    )
+    bn.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output (schema documented in README "
+        "'Benchmarking') instead of text",
+    )
+    bn.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="baseline file (default: BENCH_BASELINE.json at the repo "
+        "root)",
+    )
+    bn.add_argument(
+        "--update-baseline", action="store_true",
+        help="record this run's summaries for the current environment "
+        "fingerprint: existing entries keep their authored reason, new "
+        "ones take --reason, stale ones are dropped; other "
+        "fingerprints' entries are preserved verbatim",
+    )
+    bn.add_argument(
+        "--require-baseline", action="store_true",
+        help="strict gating: a gated metric with NO committed entry "
+        "under this host's fingerprint is a failing finding instead of "
+        "a silent 'no-baseline' pass — for preflights that must never "
+        "run ungated on a new host",
+    )
+    bn.add_argument(
+        "--reason", default=None, metavar="TEXT",
+        help="justification recorded for entries newly added by "
+        "--update-baseline (mandatory when any exist)",
+    )
+    bn.add_argument(
+        "--list-scenarios", action="store_true",
+        help="print the scenario registry and exit",
+    )
+    bsub = bn.add_subparsers(dest="bench_cmd")
+    pf = bsub.add_parser(
+        "profile",
+        help="run one scenario under the flight recorder AND "
+        "jax.profiler; merge both into ONE Perfetto file (host "
+        "handoffs and device ops on the same timeline, flow arrows "
+        "intact)",
+    )
+    pf.add_argument("scenario", help="scenario to profile")
+    pf.add_argument("--out", required=True, metavar="FILE",
+                    help="merged Perfetto trace output path")
+    # Own dest: a subparser option sharing dest="repetitions" would
+    # apply ITS default over a value already parsed by the parent
+    # (`dsst bench --repetitions 5 profile ...` silently became 1).
+    pf.add_argument("--repetitions", type=int, default=None,
+                    dest="profile_repetitions",
+                    help="repetitions to trace (default: 1, or the "
+                    "parent --repetitions when given before 'profile')")
+    pf.add_argument(
+        "--min-profiler-dur-us", type=float, default=5.0,
+        help="drop jax.profiler complete events shorter than this "
+        "(the runtimes emit ~1M sub-microsecond TraceMes per traced "
+        "second; dropped count is reported). 0 keeps everything",
+    )
+    bn.set_defaults(fn=_cmd_bench)
+
+
+def _cmd_bench(args: argparse.Namespace) -> int:
+    # Scenarios that execute audited entrypoints need the same >=8
+    # abstract devices `dsst audit` multiplexes — set before backend
+    # init (children inherit; profile runs in-process). MESH_FLAG is
+    # the ONE definition the parent and the needs_mesh child runner
+    # share: disagreeing would silently fork the fingerprint's device
+    # count. (bench.core imports no jax at module level, so this stays
+    # cheap at command time.)
+    import os
+
+    from ..bench.core import MESH_FLAG
+
+    if MESH_FLAG not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + MESH_FLAG
+        ).strip()
+
+    from ..bench import (
+        DEFAULT_BENCH_BASELINE,
+        BenchUsageError,
+        load_bench_baseline,
+        run_bench,
+        scenario_catalog,
+        write_bench_baseline,
+    )
+
+    try:
+        if getattr(args, "bench_cmd", None) == "profile":
+            from ..bench.profile import profile_scenario
+
+            reps = args.profile_repetitions
+            if reps is None:
+                reps = args.repetitions if args.repetitions else 1
+            report = profile_scenario(
+                args.scenario, args.out, repetitions=reps,
+                min_profiler_dur_us=args.min_profiler_dur_us,
+            )
+            print(
+                f"merged perfetto trace: {report['spans']} span(s), "
+                f"{report['flows']} flow event(s), "
+                f"{report['profiler_events']} profiler event(s) "
+                f"(+{report['profiler_events_dropped']} dropped under "
+                f"{args.min_profiler_dur_us:g}us) -> {report['out']}"
+            )
+            if report.get("mfu"):
+                b = report["mfu"]
+                util = b.get("utilization")
+                print(
+                    f"achieved FLOPs/s ({b['entrypoint']}): "
+                    f"{b['achieved_flops_per_sec']:.4g}"
+                    + (f" ({util:.2%} of peak)" if util is not None else "")
+                )
+            return 0
+        if args.list_scenarios:
+            for name, tier, desc in scenario_catalog():
+                print(f"{name:20s} [{tier:5s}] {desc}")
+            return 0
+        scenarios = (
+            [s.strip() for s in args.scenarios.split(",") if s.strip()]
+            if args.scenarios else None
+        )
+        if scenarios and args.tier:
+            raise BenchUsageError(
+                "--scenarios and --tier are exclusive selections"
+            )
+        baseline = (
+            Path(args.baseline) if args.baseline else DEFAULT_BENCH_BASELINE
+        )
+        res = run_bench(
+            scenarios, tier=args.tier, repetitions=args.repetitions,
+            baseline_path=baseline, isolation=not args.in_process,
+            require_baseline=args.require_baseline,
+        )
+        if args.update_baseline:
+            old = load_bench_baseline(baseline)
+            added = write_bench_baseline(baseline, res, old, args.reason)
+            print(
+                f"bench baseline {baseline}: {len(res.results)} "
+                f"scenario(s) recorded under {res.fingerprint_key} "
+                f"({added} with new reason)"
+            )
+            return 0
+        print(res.render_json() if args.json else res.render_text())
+        return res.exit_code
+    except BenchUsageError as e:
+        print(f"dsst bench: {e}", file=sys.stderr)
+        return 2
+
+
 def register_all(sub: argparse._SubParsersAction) -> None:
     register_datagen(sub)
     register_forecast(sub)
@@ -2992,6 +3168,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_lint(sub)
     register_audit(sub)
     register_sanitize(sub)
+    register_bench(sub)
     from .pipeline import register_pipeline
 
     register_pipeline(sub)

@@ -29,6 +29,11 @@ KNOWN_METRICS: dict[str, str] = {
     # -- analysis ----------------------------------------------------------
     "audit_entrypoints_total": "counter",
     "audit_findings_total": "counter",
+    # -- bench / utilization ----------------------------------------------
+    "bench_regressions_total": "counter",
+    "bench_scenarios_total": "counter",
+    "entrypoint_achieved_flops_per_sec": "gauge",
+    "entrypoint_flops_utilization": "gauge",
     # -- checkpointing / resilience ---------------------------------------
     "auto_resume_total": "counter",
     "checkpoint_fallback_total": "counter",
@@ -90,7 +95,8 @@ KNOWN_METRICS: dict[str, str] = {
 # Span name -> what the span covers. The ``span-discipline`` lint rule
 # (``dsst lint``) reconciles ``span()`` call sites against this in both
 # directions; ``dsst trace attribution`` buckets step spans by these
-# names (see _ATTRIBUTION in config/commands.py).
+# names (:data:`SPAN_ATTRIBUTION` below — the one bucket mapping it
+# shares with the bench harness's e2e cross-check).
 KNOWN_SPANS: dict[str, str] = {
     # -- training ----------------------------------------------------------
     "fit": "one Trainer.fit call, open for the whole run",
@@ -118,4 +124,58 @@ KNOWN_SPANS: dict[str, str] = {
     "trial.submit": "driver-side proposal/submission of one trial",
     # -- ingest ------------------------------------------------------------
     "ingest": "one ingest run over a raw image tree",
+}
+
+# Span name -> attribution bucket: where a step's wall time went. The
+# ONE definition shared by ``dsst trace attribution`` and the bench
+# harness's e2e cross-check (``bench/scenarios.py``) — both used to be
+# free to drift from KNOWN_SPANS independently; sourcing the mapping
+# here means a renamed span breaks the span-discipline lint, not the
+# attribution silently. Spans not listed bucket as "host".
+SPAN_ATTRIBUTION: dict[str, str] = {
+    "reader.next": "data_wait",
+    "feeder.place": "transfer",
+    "mesh.plan": "transfer",
+    "train_step": "compute",
+}
+
+# Scenario name -> the exact metric keys its schema may emit
+# (``dsst bench``). The ``bench-registry`` lint rule reconciles the
+# ``Scenario(...)`` declarations in ``bench/scenarios.py`` against this
+# in both directions, exactly as ``telemetry-registry`` holds metric
+# call sites to KNOWN_METRICS: a typo'd metric key would otherwise
+# silently fork a baseline series and dodge its regression gate.
+KNOWN_BENCH_METRICS: dict[str, tuple[str, ...]] = {
+    "compute": (
+        "compute_steps_per_sec",
+        "compute_images_per_sec",
+    ),
+    "decode": (
+        "decode_images_per_sec",
+    ),
+    "feeder_e2e": (
+        "e2e_images_per_sec",
+        "e2e_steps_per_sec",
+        "feeder_stall_fraction",
+        "e2e_unexplained_fraction",
+    ),
+    "reader": (
+        "reader_images_per_sec",
+    ),
+    "recorder_overhead": (
+        "recorder_emit_ring_us",
+        "recorder_emit_tail_us",
+        "recorder_tail_bytes_per_event",
+    ),
+    "sanitizer_overhead": (
+        "sanitizer_plain_acquire_us",
+        "sanitizer_armed_acquire_us",
+        "sanitizer_overhead_ratio",
+    ),
+    "serving": (
+        "serving_throughput_rps",
+        "serving_p50_ms",
+        "serving_p99_ms",
+        "serving_batch_fill_mean",
+    ),
 }

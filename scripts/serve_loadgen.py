@@ -1,29 +1,10 @@
 #!/usr/bin/env python
-"""Closed-loop load generator for the serving scheduler.
+"""Closed-loop load generator for the serving scheduler (CLI shim).
 
-``threads`` clients each run a closed loop (send one single-image
-POST /predict, wait for the response, repeat) for ``duration`` seconds
-— the classic closed-loop model, so offered load scales with measured
-latency and the numbers are comparable run to run. Reports p50/p99
-latency, throughput, status mix, and the server's own batch-fill and
-time-in-queue telemetry (scraped from ``GET /metrics`` as a
-before/after delta, so a shared server doesn't pollute the numbers).
-
-Two targets:
-
-- ``--url http://host:port`` — any running ``dsst serve`` instance;
-  ``--image PATH`` supplies the JPEG body (required, since the server
-  decodes for real).
-- ``--selftest`` — spawn a stub-scorer server in a SUBPROCESS (score
-  cost simulated via ``--score-ms`` per batch) and load it over real
-  sockets. No checkpoint, no accelerator: this measures the SCHEDULER
-  (admission, decode pool, cross-request batching, HTTP keep-alive),
-  which is exactly what a CI smoke run can pin. The subprocess split
-  matters: an in-process server would share the client threads' GIL
-  and inflate tail latency with scheduling artifacts.
-  `BENCH_serving.json` is produced this way.
-
-Example::
+The implementation moved to ``dss_ml_at_scale_tpu.bench.loadgen`` so
+the bench harness can register serving load as a scenario (``dsst
+bench --scenarios serving`` — the ``BENCH_serving.json`` producer);
+this shim keeps the historical entry point and flags:
 
     python scripts/serve_loadgen.py --selftest --threads 16 \
         --duration 3 --out BENCH_serving.json
@@ -31,301 +12,12 @@ Example::
 
 from __future__ import annotations
 
-import argparse
-import http.client
-import json
-import os
-import statistics
 import sys
-import threading
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-
-def _wait_ready(host: str, port: int, timeout_s: float = 30.0) -> None:
-    """Poll /healthz until the server answers, with bounded backoff.
-
-    A freshly spawned server (the --selftest subprocess, or a real `dsst
-    serve` still compiling its scorer) announces its port before the
-    accept loop is warm; connection-refused during that window must not
-    fail the whole selftest. Raises the last error once the budget is
-    spent — a server that never comes up is still a loud failure.
-    """
-    deadline = time.monotonic() + timeout_s
-    delay = 0.05
-    while True:
-        try:
-            conn = http.client.HTTPConnection(host, port, timeout=5)
-            try:
-                conn.request("GET", "/healthz")
-                conn.getresponse().read()
-            finally:
-                conn.close()
-            return
-        except OSError:
-            if time.monotonic() >= deadline:
-                raise
-            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
-            delay = min(delay * 2, 1.0)
-
-
-def _scrape(host: str, port: int) -> dict:
-    """Histogram/counter samples from /metrics (Prometheus text)."""
-    conn = http.client.HTTPConnection(host, port, timeout=10)
-    conn.request("GET", "/metrics")
-    resp = conn.getresponse()
-    text = resp.read().decode()
-    conn.close()
-    out: dict[str, float] = {}
-    for line in text.splitlines():
-        if line.startswith("#") or not line.strip():
-            continue
-        name, _, value = line.rpartition(" ")
-        if "{" in name:  # labeled series aren't needed here
-            continue
-        try:
-            out[name.strip()] = float(value)
-        except ValueError:
-            continue
-    return out
-
-
-def _hist_delta(before: dict, after: dict, name: str) -> dict:
-    count = after.get(f"{name}_count", 0.0) - before.get(f"{name}_count", 0.0)
-    total = after.get(f"{name}_sum", 0.0) - before.get(f"{name}_sum", 0.0)
-    return {
-        "count": int(count),
-        "mean": (total / count) if count else None,
-    }
-
-
-class _Client(threading.Thread):
-    """One closed-loop client over ONE keep-alive connection."""
-
-    def __init__(self, host: str, port: int, body: bytes,
-                 barrier: threading.Barrier, stop: threading.Event):
-        super().__init__(daemon=True)
-        self.host, self.port, self.body = host, port, body
-        self.barrier, self.stop = barrier, stop
-        self.latencies: list[float] = []
-        self.statuses: dict[int, int] = {}
-        self.errors = 0
-
-    def run(self) -> None:
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
-        self.barrier.wait()
-        while not self.stop.is_set():
-            t0 = time.perf_counter()
-            try:
-                conn.request("POST", "/predict", body=self.body,
-                             headers={"Content-Type": "image/jpeg"})
-                resp = conn.getresponse()
-                resp.read()
-                status = resp.status
-            except Exception:
-                self.errors += 1
-                conn.close()
-                conn = http.client.HTTPConnection(
-                    self.host, self.port, timeout=30
-                )
-                continue
-            self.latencies.append(time.perf_counter() - t0)
-            self.statuses[status] = self.statuses.get(status, 0) + 1
-        conn.close()
-
-
-def run_load(host: str, port: int, body: bytes, *, threads: int,
-             duration_s: float) -> dict:
-    before = _scrape(host, port)
-    barrier = threading.Barrier(threads + 1)
-    stop = threading.Event()
-    clients = [_Client(host, port, body, barrier, stop)
-               for _ in range(threads)]
-    for c in clients:
-        c.start()
-    barrier.wait()  # all connections up before the clock starts
-    t0 = time.perf_counter()
-    time.sleep(duration_s)
-    stop.set()
-    for c in clients:
-        c.join(10)
-    wall = time.perf_counter() - t0
-    after = _scrape(host, port)
-
-    latencies = sorted(x for c in clients for x in c.latencies)
-    statuses: dict[str, int] = {}
-    for c in clients:
-        for code, n in c.statuses.items():
-            statuses[str(code)] = statuses.get(str(code), 0) + n
-    ok = statuses.get("200", 0)
-
-    def pct(p: float):
-        if not latencies:
-            return None
-        return latencies[min(len(latencies) - 1, int(p * len(latencies)))]
-
-    return {
-        "threads": threads,
-        "duration_s": round(wall, 3),
-        "requests": len(latencies),
-        "throughput_rps": round(len(latencies) / wall, 2),
-        "ok_rps": round(ok / wall, 2),
-        "statuses": statuses,
-        "transport_errors": sum(c.errors for c in clients),
-        "latency_s": {
-            "p50": pct(0.50),
-            "p90": pct(0.90),
-            "p99": pct(0.99),
-            "mean": statistics.fmean(latencies) if latencies else None,
-        },
-        "server": {
-            "batch_fill": _hist_delta(before, after, "serving_batch_fill"),
-            "time_in_queue_s": _hist_delta(
-                before, after, "serving_time_in_queue_seconds"
-            ),
-            "rejected_429": after.get("serving_admission_rejected_total", 0.0)
-            - before.get("serving_admission_rejected_total", 0.0),
-            "deadline_503": after.get("serving_deadline_expired_total", 0.0)
-            - before.get("serving_deadline_expired_total", 0.0),
-        },
-    }
-
-
-class _StubScorer:
-    """Predictor-shaped stub with a simulated per-batch score cost."""
-
-    meta = {"model": "loadgen-stub"}
-    step = 0
-    crop = 8
-
-    def __init__(self, micro_batch: int, score_ms: float):
-        import numpy as np
-
-        self._np = np
-        self.micro_batch = micro_batch
-        self.score_s = score_ms / 1000.0
-
-    def decode(self, jpegs):
-        return self._np.zeros((len(jpegs), 1), self._np.float32)
-
-    def score(self, images):
-        if self.score_s:
-            time.sleep(self.score_s)
-        return [{"pred_index": 0, "pred_prob": 1.0} for _ in images]
-
-
-def _stub_serve(args) -> int:
-    """The --selftest server half: announce the port, serve until
-    SIGTERM, drain on the way out."""
-    import signal
-
-    from dss_ml_at_scale_tpu.serving import SchedulerConfig
-    from dss_ml_at_scale_tpu.workloads.serving import serve_in_thread
-
-    handle = serve_in_thread(
-        _StubScorer(args.micro_batch, args.score_ms),
-        config=SchedulerConfig(
-            queue_depth=args.queue_depth,
-            batch_window_ms=args.batch_window_ms,
-            deadline_ms=args.deadline_ms,
-        ),
-    )
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    print(json.dumps({"port": handle.port}), flush=True)
-    try:
-        stop.wait()
-    except KeyboardInterrupt:
-        pass
-    finally:
-        handle.close()
-    return 0
-
-
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    target = ap.add_mutually_exclusive_group(required=True)
-    target.add_argument("--url", help="running server, e.g. http://127.0.0.1:8008")
-    target.add_argument(
-        "--selftest", action="store_true",
-        help="subprocess stub server (scheduler smoke bench; no checkpoint)",
-    )
-    # Internal: the server half of --selftest (announces its port as a
-    # JSON line, serves until SIGTERM).
-    target.add_argument("--stub-serve", action="store_true",
-                        help=argparse.SUPPRESS)
-    ap.add_argument("--image", default=None,
-                    help="JPEG file to POST (required with --url)")
-    ap.add_argument("--threads", type=int, default=16)
-    ap.add_argument("--duration", type=float, default=3.0)
-    ap.add_argument("--micro-batch", type=int, default=8,
-                    help="(selftest) compiled-batch size the stub simulates")
-    ap.add_argument("--score-ms", type=float, default=5.0,
-                    help="(selftest) simulated per-batch score cost")
-    ap.add_argument("--batch-window-ms", type=float, default=5.0)
-    ap.add_argument("--queue-depth", type=int, default=64)
-    ap.add_argument("--deadline-ms", type=float, default=0.0)
-    ap.add_argument("--out", default=None, help="write the report JSON here")
-    args = ap.parse_args(argv)
-
-    if args.stub_serve:
-        return _stub_serve(args)
-
-    proc = None
-    if args.selftest:
-        import subprocess
-
-        proc = subprocess.Popen(
-            [sys.executable, __file__, "--stub-serve",
-             "--micro-batch", str(args.micro_batch),
-             "--score-ms", str(args.score_ms),
-             "--batch-window-ms", str(args.batch_window_ms),
-             "--queue-depth", str(args.queue_depth),
-             "--deadline-ms", str(args.deadline_ms)],
-            stdout=subprocess.PIPE, text=True,
-        )
-        boot = json.loads(proc.stdout.readline())
-        host, port = "127.0.0.1", boot["port"]
-        body = b"0"
-    else:
-        if not args.image:
-            ap.error("--url needs --image (a real JPEG the server can decode)")
-        url = args.url.removeprefix("http://")
-        host, _, port_s = url.partition(":")
-        port = int(port_s.rstrip("/") or 8008)
-        body = Path(args.image).read_bytes()
-
-    try:
-        _wait_ready(host, port)
-        report = {
-            "bench": "serve_loadgen",
-            "mode": "selftest" if args.selftest else "url",
-            # Tail latencies are host-sensitive: on a small shared box
-            # the p99 reflects scheduler noise, not the serving stack.
-            "host_cpus": os.cpu_count(),
-            "config": {
-                "micro_batch": args.micro_batch if args.selftest else None,
-                "score_ms": args.score_ms if args.selftest else None,
-                "batch_window_ms": args.batch_window_ms,
-                "queue_depth": args.queue_depth,
-                "deadline_ms": args.deadline_ms,
-            },
-            **run_load(host, port, body, threads=args.threads,
-                       duration_s=args.duration),
-        }
-    finally:
-        if proc is not None:
-            proc.terminate()
-            proc.wait(15)
-
-    text = json.dumps(report, indent=1)
-    print(text)
-    if args.out:
-        Path(args.out).write_text(text + "\n")
-    return 0
-
+from dss_ml_at_scale_tpu.bench.loadgen import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,0 +1,23 @@
+"""Fixture (known={"decode": ("decode_images_per_sec",), "kwform":
+("a_metric",)}): clean — declared scenarios with exact declared metric
+key sets, in both the positional and keyword Metric forms."""
+
+from dss_ml_at_scale_tpu.bench.core import Metric, Scenario, register_scenario
+
+register_scenario(Scenario(
+    name="decode",
+    description="JPEG decode throughput", tier="tier1",
+    metrics=(
+        Metric("decode_images_per_sec", "images/sec", "higher"),
+    ),
+    measure=lambda ctx: {},
+))
+
+register_scenario(Scenario(
+    name="kwform",
+    description="keyword-form Metric is just as literal", tier="tier1",
+    metrics=(
+        Metric(name="a_metric", unit="u", direction="lower"),
+    ),
+    measure=lambda ctx: {},
+))

@@ -1,0 +1,36 @@
+"""Fixture (known={"decode": ("decode_images_per_sec",), "gated":
+("a_metric", "b_metric"), "dead_scenario": ("x",)}): 6 findings —
+undeclared scenario, extra metric, missing metric, non-literal
+scenario name, non-literal metric name, dead registry entry."""
+
+from dss_ml_at_scale_tpu.bench.core import Metric, Scenario, register_scenario
+
+NAME = "computed"
+
+register_scenario(Scenario(                 # scenario not declared
+    name="mystery",
+    description="", tier="tier1",
+    metrics=(Metric("m", "u"),),
+    measure=lambda ctx: {},
+))
+
+register_scenario(Scenario(                 # extra metric + missing b_metric
+    name="gated",
+    description="", tier="tier1",
+    metrics=(Metric("a_metric", "u"), Metric("typo_metric", "u")),
+    measure=lambda ctx: {},
+))
+
+register_scenario(Scenario(                 # non-literal scenario name
+    name=NAME,
+    description="", tier="tier1",
+    metrics=(Metric("m", "u"),),
+    measure=lambda ctx: {},
+))
+
+register_scenario(Scenario(                 # non-literal metric name
+    name="decode",
+    description="", tier="tier1",
+    metrics=(Metric(NAME, "u"),),
+    measure=lambda ctx: {},
+))

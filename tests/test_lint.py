@@ -33,6 +33,9 @@ from dss_ml_at_scale_tpu.analysis import (
 from dss_ml_at_scale_tpu.analysis.checkers.bare_except import (
     BareExceptChecker,
 )
+from dss_ml_at_scale_tpu.analysis.checkers.bench_registry import (
+    BenchRegistryChecker,
+)
 from dss_ml_at_scale_tpu.analysis.checkers.durable_write import (
     DurableWriteChecker,
 )
@@ -151,6 +154,19 @@ RULES = {
         lambda: SpanDisciplineChecker(
             known={"train_step": "", "train_epoch": ""}
         ), None,
+    ),
+    "bench_registry_pos": (
+        lambda: BenchRegistryChecker(known={
+            "decode": ("decode_images_per_sec",),
+            "gated": ("a_metric", "b_metric"),
+            "dead_scenario": ("x",),
+        }), 6,
+    ),
+    "bench_registry_neg": (
+        lambda: BenchRegistryChecker(known={
+            "decode": ("decode_images_per_sec",),
+            "kwform": ("a_metric",),
+        }), None,
     ),
 }
 

@@ -43,7 +43,15 @@ if ! JAX_PLATFORMS=cpu timeout 600 python -m dss_ml_at_scale_tpu.config.cli \
   echo "$(date -u +%H:%M:%S) preflight FAILED: dsst sanitize dirty - watchdog refusing to arm" >> tpu_watchdog.log
   exit 1
 fi
-echo "$(date -u +%H:%M:%S) preflight clean: lint + audit + sanitize" >> tpu_watchdog.log
+# 1500s: must exceed the SUM of tier-1 per-scenario child timeouts
+# (~1260s worst case) so a hung scenario dies to ITS watchdog with a
+# per-scenario finding/salvage note, not to this blanket kill.
+if ! JAX_PLATFORMS=cpu timeout 1500 python -m dss_ml_at_scale_tpu.config.cli \
+    bench --tier tier1 >> tpu_watchdog.log 2>&1; then
+  echo "$(date -u +%H:%M:%S) preflight FAILED: dsst bench tier1 regressed - watchdog refusing to arm" >> tpu_watchdog.log
+  exit 1
+fi
+echo "$(date -u +%H:%M:%S) preflight clean: lint + audit + sanitize + bench" >> tpu_watchdog.log
 N=0
 while true; do
   if [ "$(date -u +%s)" -ge "$DEADLINE_EPOCH" ]; then
