@@ -174,7 +174,20 @@ def register_forecast(sub: argparse._SubParsersAction) -> None:
     )
     fc.add_argument("--data", required=True, help="demand Delta table")
     fc.add_argument("--out", required=True, help="forecast Delta table to write")
-    fc.add_argument("--max-evals", type=int, default=10)
+    fc.add_argument(
+        "--search", choices=("grid", "tpe"), default="grid",
+        help="grid: fuse the full (p,d,q) order grid into chunked "
+        "launches with on-device argmin (exact optimum, fewest "
+        "launches); tpe: the reference's per-round batched TPE "
+        "(compatibility path)",
+    )
+    fc.add_argument(
+        "--chunk-size", type=int, default=None,
+        help="groups per grid-fused launch (default: min(G, 1024), "
+        "rounded up to the mesh axis)",
+    )
+    fc.add_argument("--max-evals", type=int, default=10,
+                    help="TPE rounds (--search tpe only)")
     fc.add_argument("--horizon", type=int, default=40, help="holdout weeks")
     fc.add_argument("--rstate", type=int, default=123)
     fc.add_argument(
@@ -216,6 +229,8 @@ def _cmd_forecast(args: argparse.Namespace) -> int:
         rstate=args.rstate,
         mesh=mesh,
         cfg=cfg,
+        search=args.search,
+        chunk_size=args.chunk_size,
     )
     write_delta(
         pa.Table.from_pandas(out, preserve_index=False), args.out, mode="overwrite"
@@ -226,8 +241,8 @@ def _cmd_forecast(args: argparse.Namespace) -> int:
     groups = out.groupby(["Product", "SKU"]).ngroups
     _finish_tracker(
         _open_tracker(args, "forecast"),
-        params={"max_evals": args.max_evals, "horizon": args.horizon,
-                "groups": groups},
+        params={"search": args.search, "max_evals": args.max_evals,
+                "horizon": args.horizon, "groups": groups},
         metrics={"mse": mse, "wall_s": dt}, step=0,
     )
     print(

@@ -22,8 +22,11 @@ from .neldermead import NelderMeadResult, nelder_mead
 from .polish import sarimax_polish
 from .sarimax import (
     SarimaxConfig,
+    SarimaxGridResult,
     SarimaxResult,
+    grid_orders,
     sarimax_fit,
+    sarimax_fit_grid,
     sarimax_loglike,
     sarimax_predict,
 )
@@ -42,8 +45,11 @@ __all__ = [
     "NelderMeadResult",
     "nelder_mead",
     "SarimaxConfig",
+    "SarimaxGridResult",
     "SarimaxResult",
+    "grid_orders",
     "sarimax_fit",
+    "sarimax_fit_grid",
     "sarimax_loglike",
     "sarimax_polish",
     "sarimax_predict",

@@ -71,6 +71,7 @@ KNOWN_METRICS: dict[str, str] = {
     "reader_stall_seconds_total": "counter",
     # -- training / HPO ----------------------------------------------------
     "hpo_trials_total": "counter",
+    "skus_fitted_total": "counter",
     "pipeline_utilization": "gauge",
     "train_compile_events_total": "counter",
     "train_data_wait_seconds": "histogram",
@@ -122,6 +123,11 @@ KNOWN_SPANS: dict[str, str] = {
     # -- HPO ---------------------------------------------------------------
     "trial": "one HPO trial evaluation",
     "trial.submit": "driver-side proposal/submission of one trial",
+    # -- group fit ---------------------------------------------------------
+    "panel.build": "pad_groups stacking a long frame into the (G, L) "
+                   "panel (vectorized scatter, host-side)",
+    "grid.chunk": "one grid-fused group-fit launch: place one chunk, "
+                  "fit the full order grid, device argmin",
     # -- ingest ------------------------------------------------------------
     "ingest": "one ingest run over a raw image tree",
 }
@@ -137,6 +143,8 @@ SPAN_ATTRIBUTION: dict[str, str] = {
     "feeder.place": "transfer",
     "mesh.plan": "transfer",
     "train_step": "compute",
+    "panel.build": "host",
+    "grid.chunk": "compute",
 }
 
 # Scenario name -> the exact metric keys its schema may emit
@@ -158,6 +166,15 @@ KNOWN_BENCH_METRICS: dict[str, tuple[str, ...]] = {
         "e2e_steps_per_sec",
         "feeder_stall_fraction",
         "e2e_unexplained_fraction",
+    ),
+    "group_fit": (
+        "group_fit_skus_per_sec",
+        "group_fit_fits_per_sec",
+        "group_fit_launches_per_sec",
+    ),
+    "group_fit_10k": (
+        "group_fit_10k_skus_per_sec",
+        "group_fit_10k_chunks",
     ),
     "reader": (
         "reader_images_per_sec",

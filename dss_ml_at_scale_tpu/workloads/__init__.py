@@ -8,6 +8,7 @@ pipeline of ``group_apply/02_Fine_Grained_Demand_Forecasting.py``.
 from .eda import EdaReport, extract_sku_series, run_eda  # noqa: F401
 from .forecasting import (
     EXO_FIELDS,
+    GROUP_FIT_BENCH_CFG,
     SEARCH_SPACE,
     add_exo_variables,
     build_tune_and_score_model,
@@ -20,6 +21,7 @@ __all__ = [
     "extract_sku_series",
     "run_eda",
     "EXO_FIELDS",
+    "GROUP_FIT_BENCH_CFG",
     "SEARCH_SPACE",
     "add_exo_variables",
     "build_tune_and_score_model",

@@ -461,7 +461,9 @@ def make_server(predictor, host: str = "127.0.0.1",
                 return
             self._json(200, {"predictions": preds})
 
-    server = _ServingHTTPServer((host, port), Handler)
+    server = _ServingHTTPServer(
+        (host, port), Handler, queue_depth=scheduler.config.queue_depth
+    )
     server.scheduler = scheduler
     server.lifecycle = lifecycle
     scheduler.start()
@@ -476,6 +478,21 @@ class _ServingHTTPServer(ThreadingHTTPServer):
     # threads: close() returns once the drain settled the WORK — the
     # response bytes flush from threads that die with the process.
     daemon_threads = True
+    # Backpressure belongs to the admission controller (measured 429 +
+    # Retry-After), not the kernel: the stdlib default TCP backlog of 5
+    # reset concurrent connects the scheduler's queue_depth would have
+    # admitted or politely rejected. The accept queue is sized with the
+    # CONFIGURED admission queue (not a constant that a larger
+    # queue_depth could outgrow) so every client gets an HTTP answer.
+    request_queue_size = 128
+
+    def __init__(self, addr, handler, queue_depth: int = 0):
+        # server_bind reads request_queue_size at listen() time; the
+        # instance attribute must exist before super().__init__ binds.
+        self.request_queue_size = max(
+            type(self).request_queue_size, 2 * queue_depth
+        )
+        super().__init__(addr, handler)
 
 
 def serve_in_thread(predictor, host: str = "127.0.0.1", port: int = 0, *,

@@ -35,15 +35,16 @@ fi
 # Fourth tier: the tier-1 bench scenarios against the committed
 # BENCH_BASELINE.json — a host-side performance regression (decode,
 # reader, scheduler, recorder overhead) measured BEFORE the claim is a
-# finding on CPU time, not a mystery in the on-chip numbers. 1500s
-# exceeds the sum of tier-1 per-scenario child timeouts (~1260s), so a
+# finding on CPU time, not a mystery in the on-chip numbers. 2100s
+# exceeds the sum of tier-1 per-scenario child timeouts (~1680s with
+# the group_fit grid launch), so a
 # hung scenario dies to ITS watchdog (per-scenario finding + salvage)
 # rather than this blanket kill.
 # NOTE: baselines are environment-fingerprinted; on a host with no
 # committed entry gated metrics report no-baseline and PASS — run
 # `dsst bench --update-baseline --reason '...'` there once (or add
 # --require-baseline to hard-fail ungated hosts).
-if ! JAX_PLATFORMS=cpu timeout 1500 python -m dss_ml_at_scale_tpu.config.cli bench --tier tier1; then
+if ! JAX_PLATFORMS=cpu timeout 2100 python -m dss_ml_at_scale_tpu.config.cli bench --tier tier1; then
   echo "preflight FAILED: dsst bench tier1 regressed - refusing to spend the TPU claim"
   exit 1
 fi

@@ -8,10 +8,17 @@ import jax
 import jax.numpy as jnp
 
 from dss_ml_at_scale_tpu.hpo import Trials, fmin, hp
-from dss_ml_at_scale_tpu.ops import SarimaxConfig
+from dss_ml_at_scale_tpu.ops import (
+    SarimaxConfig,
+    grid_orders,
+    sarimax_fit,
+    sarimax_fit_grid,
+    sarimax_loglike,
+)
 from dss_ml_at_scale_tpu.parallel.group_apply import (
     batched_fmin,
     device_put_groups,
+    grid_fit_panel,
     group_apply,
     pad_groups,
     pad_to_multiple,
@@ -149,6 +156,41 @@ def test_pad_groups_ragged():
     assert list(padded.keys["k"]) == ["a", "b"]
 
 
+def test_pad_groups_stable_within_group_order():
+    # Duplicate sort keys must keep frame order (stable lexsort): the
+    # vectorized scatter cannot reorder ties the way an unstable
+    # per-group quicksort could.
+    df = pd.DataFrame(
+        {
+            "k": ["a", "a", "a", "b", "b"],
+            "t": [1, 0, 1, 2, 2],
+            "v": [10.0, 20.0, 30.0, 40.0, 50.0],
+        }
+    )
+    padded = pad_groups(df, "k", ["v"], sort_by="t")
+    np.testing.assert_allclose(padded.values["v"][0], [20, 10, 30])
+    np.testing.assert_allclose(padded.values["v"][1, :2], [40, 50])
+    # No sort_by: rows keep frame order within each group.
+    padded2 = pad_groups(df, "k", ["v"])
+    np.testing.assert_allclose(padded2.values["v"][0], [10, 20, 30])
+
+
+def test_pad_groups_drops_null_key_rows():
+    # groupby drops null-key groups; the vectorized scatter must mirror
+    # that (not crash on the NaN ngroup codes those rows produce).
+    df = pd.DataFrame(
+        {
+            "k": ["a", None, "b", "a"],
+            "v": [1.0, 99.0, 3.0, 2.0],
+        }
+    )
+    padded = pad_groups(df, "k", ["v"])
+    assert padded.n_groups == 2
+    np.testing.assert_allclose(padded.values["v"][0], [1, 2])
+    np.testing.assert_allclose(padded.values["v"][1], [3, 0])
+    assert list(padded.keys["k"]) == ["a", "b"]
+
+
 def test_pad_to_multiple_and_mesh_sharding(devices8):
     mesh = make_mesh({"data": 8})
     arr = np.arange(5 * 4, dtype=np.float32).reshape(5, 4)
@@ -211,6 +253,235 @@ def test_batched_fmin_independent_groups():
         batched_fmin(
             lambda pts: np.full(3, np.nan), space, 2, 3, rstate=0
         )
+
+
+# -- grid-fused engine --------------------------------------------------------
+
+# Tiny exog-free config: K = 4 orders, short NM chains — grid-engine
+# mechanics (argmin, chunking, sharding) without golden-grade fit cost.
+TINY_CFG = SarimaxConfig(
+    max_p=1, max_d=0, max_q=1, k_exog=0, max_iter=12, bfgs_iter=0
+)
+
+
+def _series_panel(rng, G=6, L=24, holdout=6):
+    y = (50 + np.cumsum(rng.normal(0, 1, (G, L)), axis=1)).astype(np.float32)
+    exog = np.zeros((G, L, 0), np.float32)
+    n_valid = np.full(G, L, np.int32)
+    n_train = np.full(G, L - holdout, np.int32)
+    return y, exog, n_train, n_valid
+
+
+def test_grid_fit_device_argmin_matches_host_argmin(rng):
+    # The on-device reduction must agree with fitting each order
+    # separately and reducing on the host: same kernel, same winner.
+    y, exog, n_train, n_valid = _series_panel(rng, G=1)
+    orders = grid_orders(TINY_CFG)
+    assert orders.shape == (4, 3)  # 2 x 1 x 2 at the tiny bounds
+    res = sarimax_fit_grid(
+        TINY_CFG, y[0], exog[0], orders, n_train[0], n_valid[0],
+        select="loglike",
+    )
+    per_order = [
+        float(sarimax_fit(TINY_CFG, y[0], exog[0], o, n_train[0]).loglike)
+        for o in orders
+    ]
+    # Tolerance: the vmapped fit plane and a single-lane fit are
+    # different compiled programs; f32 NM can settle a few hundredths
+    # of a nat apart without the winner changing.
+    assert float(res.loglike) >= max(per_order) - 0.05
+    assert float(res.loglike) == pytest.approx(max(per_order), abs=0.05)
+    # The winner's loglike is the exact (unconcentrated) likelihood at
+    # the returned params.
+    ll = float(sarimax_loglike(
+        TINY_CFG, res.params, y[0], exog[0], res.order, n_train[0]
+    ))
+    assert ll == pytest.approx(float(res.loglike), abs=1e-3)
+
+
+def test_grid_fit_panel_chunking_invariant(rng):
+    # The chunked launch family must reproduce the single-launch result
+    # exactly: padding lanes are discarded work, never visible output.
+    from dss_ml_at_scale_tpu import telemetry
+
+    def fitted_total():
+        for m in telemetry.snapshot()["metrics"]:
+            if m["name"] == "skus_fitted_total":
+                return m["value"]
+        return 0.0
+
+    y, exog, n_train, n_valid = _series_panel(rng, G=10)
+    fitted0 = fitted_total()
+    full = grid_fit_panel(TINY_CFG, y, exog, n_train, n_valid)
+    chunked = grid_fit_panel(
+        TINY_CFG, y, exog, n_train, n_valid, chunk_size=4
+    )
+    assert full.chunks == 1 and chunked.chunks == 3
+    np.testing.assert_array_equal(full.order, chunked.order)
+    np.testing.assert_allclose(full.pred, chunked.pred, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        full.loglike, chunked.loglike, rtol=1e-5, atol=1e-4
+    )
+    assert full.pred.shape == y.shape
+    # 10 real groups per call; pad lanes are never counted as fitted.
+    assert fitted_total() - fitted0 == 20
+
+
+def test_grid_beats_tpe_on_holdout(rng):
+    # The tentpole claim at workload level: the exact grid argmin is
+    # never worse than TPE sampling of the same space, per group, on
+    # the reference's own tuning objective (holdout MSE).
+    cfg = SarimaxConfig(
+        max_p=1, max_d=1, max_q=1, k_exog=3, max_iter=20, bfgs_iter=0
+    )
+    df = add_exo_variables(_demand_frame(rng, n_sku=3, weeks=40))
+    kwargs = dict(forecast_horizon=8, cfg=cfg)
+    grid = tune_and_forecast_panel(df, **kwargs)
+    tpe = tune_and_forecast_panel(df, max_evals=3, search="tpe", **kwargs)
+    assert len(grid) == len(df)
+    for sku, g in grid.groupby("SKU"):
+        t = tpe[tpe["SKU"] == sku]
+        hold_g = g.tail(8)
+        hold_t = t.tail(8)
+        mse_g = float(np.mean(
+            (hold_g["Demand"].to_numpy() - hold_g["Demand_Fitted"].to_numpy()) ** 2
+        ))
+        mse_t = float(np.mean(
+            (hold_t["Demand"].to_numpy() - hold_t["Demand_Fitted"].to_numpy()) ** 2
+        ))
+        assert mse_g <= mse_t + 1e-2, (sku, mse_g, mse_t)
+
+
+def test_tune_and_forecast_panel_rejects_unknown_search(rng):
+    df = add_exo_variables(_demand_frame(rng, n_sku=1, weeks=20))
+    with pytest.raises(ValueError, match="search"):
+        tune_and_forecast_panel(df, search="bogus")
+
+
+def test_tune_and_forecast_panel_drops_null_key_rows(rng):
+    # pad_groups drops null-key rows (groupby semantics); reassembly
+    # must work from the same filtered row set, not crash on a length
+    # mismatch. The launch-count side channel rides the output frame.
+    cfg = SarimaxConfig(
+        max_p=0, max_d=0, max_q=0, k_exog=3, max_iter=5, bfgs_iter=0
+    )
+    df = add_exo_variables(_demand_frame(rng, n_sku=2, weeks=20))
+    df.loc[3, "SKU"] = None
+    out = tune_and_forecast_panel(df, forecast_horizon=5, cfg=cfg)
+    assert len(out) == len(df) - 1
+    assert np.isfinite(out["Demand_Fitted"]).all()
+    assert out.attrs["grid_chunks"] == 1
+    assert out.attrs["groups_fitted"] == 2
+
+
+def test_axis_name_threads_through_nondata_mesh(rng, devices8):
+    # Satellite regression: a mesh whose group axis is NOT named "data"
+    # must work on both paths — put_orders used to hardcode "data" and
+    # mis-shard (crash) the TPE path's orders.
+    mesh = make_mesh({"groups": 8})
+    cfg = SarimaxConfig(
+        max_p=0, max_d=0, max_q=0, k_exog=3, max_iter=5, bfgs_iter=0
+    )
+    df = add_exo_variables(_demand_frame(rng, n_sku=2, weeks=20))
+    for search in ("grid", "tpe"):
+        out = tune_and_forecast_panel(
+            df, max_evals=1, forecast_horizon=5, cfg=cfg, mesh=mesh,
+            axis_name="groups", search=search,
+        )
+        assert len(out) == len(df), search
+        assert np.isfinite(out["Demand_Fitted"]).all(), search
+
+
+@pytest.mark.slow
+def test_grid_parity_on_golden_fixture():
+    # Acceptance gate: on the golden fixture series, the grid-fused
+    # path's best loglike is >= the per-round batched_fmin path's best
+    # (same fit kernel, same search space; exact argmin vs 10 TPE
+    # samples at the reference's rstate).
+    import json
+    from pathlib import Path
+
+    from dss_ml_at_scale_tpu.workloads import SEARCH_SPACE
+
+    fix = json.loads(
+        (Path(__file__).parent / "fixtures" / "sarimax_golden.json")
+        .read_text()
+    )
+    y = np.asarray(fix["y"], np.float32)
+    exog = np.asarray(fix["exog"], np.float32)
+    n_valid = int(fix["n_valid"])
+    cfg = SarimaxConfig(k_exog=3, max_iter=100, bfgs_iter=0)
+    orders = grid_orders(cfg)
+    assert orders.shape == (75, 3)  # the full reference grid
+
+    res = sarimax_fit_grid(
+        cfg, y, exog, orders, n_valid, n_valid, select="loglike"
+    )
+
+    def evaluate(points):
+        o = np.array(
+            [[points[0]["p"], points[0]["d"], points[0]["q"]]], np.int32
+        )
+        ll = float(sarimax_fit(cfg, y, exog, o[0], n_valid).loglike)
+        return np.array([-ll])
+
+    _, hist = batched_fmin(evaluate, SEARCH_SPACE, 10, 1, rstate=123)
+    tpe_best_ll = -min(loss for _, loss in hist[0])
+    assert float(res.loglike) >= tpe_best_ll - 1e-2, (
+        f"grid {float(res.loglike)} vs tpe {tpe_best_ll}"
+    )
+
+
+@pytest.mark.slow
+def test_grid_host_path_matches_device_path(rng):
+    # applyInPandas-style host path (one grid-fused 1-group panel per
+    # group) vs the batched device path: same fits, same forecasts.
+    from dss_ml_at_scale_tpu.workloads import build_tune_and_score_model
+
+    cfg = SarimaxConfig(
+        max_p=1, max_d=1, max_q=1, k_exog=3, max_iter=20, bfgs_iter=0
+    )
+    df = add_exo_variables(_demand_frame(rng, n_sku=3, weeks=36))
+    device = tune_and_forecast_panel(df, forecast_horizon=8, cfg=cfg)
+    host = group_apply(
+        df, ["Product", "SKU"],
+        lambda g: build_tune_and_score_model(
+            g, forecast_horizon=8, cfg=cfg
+        ),
+        executor="inline",
+    )
+    key = ["Product", "SKU", "Date"]
+    device = device.sort_values(key).reset_index(drop=True)
+    host = host.sort_values(key).reset_index(drop=True)
+    pd.testing.assert_frame_equal(device[key], host[key])
+    np.testing.assert_allclose(
+        device["Demand_Fitted"], host["Demand_Fitted"],
+        rtol=1e-4, atol=1e-3,
+    )
+
+
+@pytest.mark.slow
+def test_grid_fit_panel_10k_chunked_smoke(rng, devices8):
+    # ROADMAP item 3 scale shape: 10k groups through the bounded chunked
+    # launch family, sharded over the mesh — no host loop, no per-group
+    # Python, finite output for every group.
+    cfg = SarimaxConfig(
+        max_p=1, max_d=0, max_q=0, k_exog=0, max_iter=8, bfgs_iter=0
+    )
+    G, L = 10_000, 16
+    y = (20 + np.cumsum(rng.normal(0, 1, (G, L)), axis=1)).astype(np.float32)
+    exog = np.zeros((G, L, 0), np.float32)
+    n_train = np.full(G, L - 4, np.int32)
+    n_valid = np.full(G, L, np.int32)
+    mesh = make_mesh({"data": 8})
+    res = grid_fit_panel(
+        cfg, y, exog, n_train, n_valid, mesh=mesh, chunk_size=2048
+    )
+    assert res.chunks == 5
+    assert res.pred.shape == (G, L)
+    assert res.order.shape == (G, 3)
+    assert np.isfinite(res.loglike).all()
+    assert np.isfinite(res.pred).all()
 
 
 # -- forecasting workload -----------------------------------------------------

@@ -43,10 +43,11 @@ if ! JAX_PLATFORMS=cpu timeout 600 python -m dss_ml_at_scale_tpu.config.cli \
   echo "$(date -u +%H:%M:%S) preflight FAILED: dsst sanitize dirty - watchdog refusing to arm" >> tpu_watchdog.log
   exit 1
 fi
-# 1500s: must exceed the SUM of tier-1 per-scenario child timeouts
-# (~1260s worst case) so a hung scenario dies to ITS watchdog with a
+# 2100s: must exceed the SUM of tier-1 per-scenario child timeouts
+# (~1680s worst case with the group_fit grid launch) so a hung
+# scenario dies to ITS watchdog with a
 # per-scenario finding/salvage note, not to this blanket kill.
-if ! JAX_PLATFORMS=cpu timeout 1500 python -m dss_ml_at_scale_tpu.config.cli \
+if ! JAX_PLATFORMS=cpu timeout 2100 python -m dss_ml_at_scale_tpu.config.cli \
     bench --tier tier1 >> tpu_watchdog.log 2>&1; then
   echo "$(date -u +%H:%M:%S) preflight FAILED: dsst bench tier1 regressed - watchdog refusing to arm" >> tpu_watchdog.log
   exit 1
