@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     lock_discipline,
     no_print,
     retrace_hazard,
+    slo_registry,
     span_discipline,
     telemetry_registry,
     trace_safety,

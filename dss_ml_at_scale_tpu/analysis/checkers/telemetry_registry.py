@@ -26,10 +26,11 @@ import ast
 from ..astutil import call_name
 from ..core import Checker, FileContext, Finding, register_checker
 
-_KINDS = {"counter", "gauge", "histogram"}
+_KINDS = {"counter", "gauge", "histogram", "window"}
 # Functions allowed to forward a variable metric name: the telemetry
 # facade itself plus registry internals.
-_FORWARDERS = {"counter", "gauge", "histogram", "_get", "_new_child"}
+_FORWARDERS = {"counter", "gauge", "histogram", "window", "_get",
+               "_new_child"}
 # The definition layer: the registry and facade declare no metrics of
 # their own; scanning them would flag their own forwarding signatures.
 _SKIP_FILES = {

@@ -31,6 +31,8 @@ import threading
 import time
 from pathlib import Path
 
+from ..telemetry.windows import quantile
+
 
 def _wait_ready(host: str, port: int, timeout_s: float = 30.0) -> None:
     """Poll /healthz until the server answers, with bounded backoff.
@@ -151,9 +153,13 @@ def run_load(host: str, port: int, body: bytes, *, threads: int,
     ok = statuses.get("200", 0)
 
     def pct(p: float):
+        # THE shared quantile definition (telemetry.windows.quantile):
+        # the offline p50/p99 here and the live windowed sketch on
+        # /metrics compute the same statistic — they can only differ by
+        # the sketch's bounded bucket error, never by definition drift.
         if not latencies:
             return None
-        return latencies[min(len(latencies) - 1, int(p * len(latencies)))]
+        return quantile(latencies, p)
 
     return {
         "threads": threads,

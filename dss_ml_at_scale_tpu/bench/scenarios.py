@@ -709,7 +709,99 @@ register_scenario(Scenario(
 ))
 
 
+# -- slo overhead -------------------------------------------------------------
+
+_SLO_OBS = 20_000
+
+
+def _slo_values(n: int) -> list[float]:
+    """Deterministic observation values spanning the sketch's decades
+    (a single constant would hit one bucket's cache line forever and
+    understate the bisect cost)."""
+    return [10.0 ** (-5 + (i % 83) / 11.0) for i in range(n)]
+
+
+def _slo_overhead_measure(_ctx) -> dict:
+    from ..telemetry.registry import MetricsRegistry
+    from ..telemetry.windows import SlidingQuantile
+
+    reg = MetricsRegistry()
+    # dsst: ignore[telemetry-registry] private throwaway registry: a bench probe series, never rendered on /metrics
+    hist = reg.histogram("slo_overhead_probe_hist")
+    sketch = SlidingQuantile()
+    vals = _slo_values(_SLO_OBS)
+    # Warm both paths (allocate the first digest, touch the buckets).
+    for v in vals[:64]:
+        hist.observe(v)
+        sketch.observe(v)
+    t0 = time.perf_counter()
+    for v in vals:
+        hist.observe(v)
+    hist_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for v in vals:
+        sketch.observe(v)
+    sketch_dt = time.perf_counter() - t0
+    sketch_us = sketch_dt / _SLO_OBS * 1e6
+    # The acceptance bound, self-verified like feeder_e2e's attribution
+    # cross-check: one windowed emit must cost under 1% of a 1 ms step
+    # budget (i.e. <10 µs) — a sketch that got expensive must fail the
+    # scenario loudly, not ship a quietly slower hot path.
+    frac = sketch_us / 1000.0
+    if frac >= 0.01:
+        raise RuntimeError(
+            f"windowed-sketch emit costs {sketch_us:.2f}us — "
+            f"{frac:.1%} of a 1ms step budget (>=1%); the sliding "
+            "window stopped being histogram-cheap"
+        )
+    return {
+        "slo_sketch_observe_us": sketch_us,
+        "slo_hist_observe_us": hist_dt / _SLO_OBS * 1e6,
+        "slo_overhead_ratio": (
+            sketch_dt / hist_dt if hist_dt > 0 else 0.0
+        ),
+        "slo_emit_step_fraction": frac,
+    }
+
+
+register_scenario(Scenario(
+    name="slo_overhead",
+    description="windowed-sketch emit cost vs plain histogram observe "
+    "(the live SLO plane's hot-path tax); self-verifies the sketch "
+    "emit stays under 1% of a 1ms step budget",
+    tier="tier1",
+    metrics=(
+        Metric("slo_sketch_observe_us", "us/observe", "lower",
+               gate=False),
+        Metric("slo_hist_observe_us", "us/observe", "lower", gate=False),
+        # The ratio cancels host speed (the sanitizer_overhead idiom);
+        # floor 1.5 tolerates scheduler noise while catching a sketch
+        # cost blow-up vs the histogram it rides next to.
+        Metric("slo_overhead_ratio", "x", "lower", floor=1.5),
+        Metric("slo_emit_step_fraction", "fraction", "lower",
+               gate=False),
+    ),
+    measure=_slo_overhead_measure,
+    repetitions=5,
+    timeout_s=120.0,
+))
+
+
 # -- serving loadgen ----------------------------------------------------------
+
+
+def _scrape_slo(port: int) -> dict:
+    """The stub server's /slo document (schema v1)."""
+    import http.client
+    import json
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", "/slo")
+        resp = conn.getresponse()
+        return json.loads(resp.read())
+    finally:
+        conn.close()
 
 
 def _serving_setup():
@@ -733,12 +825,40 @@ def _serving_measure(ctx) -> dict:
         "127.0.0.1", ctx["port"], b"0", threads=8, duration_s=1.2,
     )
     fill = report["server"]["batch_fill"]["mean"]
+    # The live-vs-offline agreement check: the server's windowed p99
+    # (the SLO plane's serving_latency_p99 value, fed by the same
+    # requests the loadgen just timed) must agree with the loadgen's
+    # offline p99 — both route through telemetry.windows.quantile, so
+    # the only legitimate gaps are the sketch's bounded bucket error
+    # and the client's socket overhead. A wild disagreement means the
+    # live plane is measuring something other than what clients see.
+    status = _scrape_slo(ctx["port"])
+    lat = next(
+        (o for o in status.get("objectives", [])
+         if o["name"] == "serving_latency_p99"), {},
+    )
+    live_p99 = lat.get("value")
+    offline_p99 = report["latency_s"]["p99"]
+    if (
+        live_p99 and offline_p99
+        and report["requests"] >= 100
+        and not (0.2 <= live_p99 / offline_p99 <= 5.0)
+    ):
+        raise RuntimeError(
+            f"live windowed p99 {live_p99 * 1e3:.1f}ms disagrees with "
+            f"the loadgen's offline p99 {offline_p99 * 1e3:.1f}ms far "
+            "beyond sketch error + client overhead — the live SLO "
+            "plane is not measuring what clients experience"
+        )
     return {
         "serving_throughput_rps": report["throughput_rps"],
         "serving_p50_ms": (report["latency_s"]["p50"] or 0.0) * 1e3,
-        "serving_p99_ms": (report["latency_s"]["p99"] or 0.0) * 1e3,
+        "serving_p99_ms": (offline_p99 or 0.0) * 1e3,
         "serving_batch_fill_mean": fill if fill is not None else 0.0,
-        "_extra": {"loadgen": report},
+        "serving_live_p99_ms": (live_p99 or 0.0) * 1e3,
+        # The /slo snapshot rides the artifact so CI can gate on it
+        # after the bench: `dsst slo check --report <bench json>`.
+        "_extra": {"loadgen": report, "slo": status},
     }
 
 
@@ -754,6 +874,7 @@ register_scenario(Scenario(
         Metric("serving_p50_ms", "ms", "lower", floor=0.6),
         Metric("serving_p99_ms", "ms", "lower", gate=False),
         Metric("serving_batch_fill_mean", "images", "higher", gate=False),
+        Metric("serving_live_p99_ms", "ms", "lower", gate=False),
     ),
     setup=_serving_setup,
     teardown=_serving_teardown,

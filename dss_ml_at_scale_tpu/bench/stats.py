@@ -30,6 +30,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Sequence
 
+from ..telemetry.windows import quantile
+
 # A regression must clear BOTH the relative floor and this many
 # combined-MAD units — the classic robust-z idiom (MAD ≈ 0.6745 σ for
 # a normal distribution, so 4 MADs ≈ 2.7 σ).
@@ -51,14 +53,13 @@ def discard_warmup(samples: Sequence, warmup: int) -> list:
 
 
 def median(xs: Sequence[float]) -> float:
+    # Delegates to THE shared quantile helper (telemetry.windows):
+    # q=0.5 under linear rank interpolation is exactly the classic
+    # midpoint median, and single-sourcing the math keeps the bench
+    # verdicts and the live sketches from ever drifting apart.
     if not xs:
         raise ValueError("median of no samples")
-    s = sorted(xs)
-    n = len(s)
-    mid = n // 2
-    if n % 2:
-        return float(s[mid])
-    return (s[mid - 1] + s[mid]) / 2.0
+    return quantile(xs, 0.5)
 
 
 def mad(xs: Sequence[float], center: float | None = None) -> float:

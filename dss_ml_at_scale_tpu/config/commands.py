@@ -2056,6 +2056,14 @@ def _cmd_runs_doctor(args: argparse.Namespace) -> int:
                     f" — flight recorder: {cls['trace_file']} "
                     "(dsst trace tail)"
                 )
+            if (
+                cls["effective_status"] == "INTERRUPTED"
+                and cls.get("firing_alerts")
+            ):
+                line += (
+                    " — SLO alerts firing at death: "
+                    + ", ".join(cls["firing_alerts"])
+                )
             print(line)
         n_marked = sum(1 for c in report if c.get("marked"))
         print(
@@ -2308,6 +2316,14 @@ def _print_snapshot_table(snapshot: dict) -> None:
             mean = (m.get("sum", 0.0) / count) if count else 0.0
             value = (f"count={count} sum={m.get('sum', 0.0):.6g} "
                      f"mean={mean:.6g}")
+        elif m.get("type") == "window":
+            qs = " ".join(
+                f"p{float(q) * 100:g}="
+                + (f"{v:.6g}" if v is not None else "-")
+                for q, v in sorted(m.get("quantiles", {}).items())
+            )
+            value = (f"count={m.get('count', 0)} {qs} "
+                     f"[{m.get('window_s', 0):g}s window]")
         else:
             value = f"{m.get('value', 0.0):.6g}"
         rows.append((name, m.get("type", "?"), value))
@@ -3162,6 +3178,379 @@ def _cmd_bench(args: argparse.Namespace) -> int:
         return 2
 
 
+# --------------------------------------------------------------------------
+# slo / top (the live monitoring plane's CLI face)
+# --------------------------------------------------------------------------
+
+def _add_slo_source_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--url", default="http://127.0.0.1:8008", metavar="URL",
+        help="a running dsst serve process (its /slo endpoint is "
+        "scraped); default matches `dsst serve`'s default port",
+    )
+    p.add_argument(
+        "--report", default=None, metavar="JSON",
+        help="judge a saved document instead of a live process: either "
+        "a raw /slo status JSON or a `dsst bench --json` artifact "
+        "whose serving scenario embedded one (results.serving.extra."
+        "slo) — what CI runs after the serving bench",
+    )
+
+
+def register_slo(sub: argparse._SubParsersAction) -> None:
+    so = sub.add_parser(
+        "slo",
+        help="live SLOs: declared objectives, windowed values, "
+        "burn rates, and alert states — baseline-free (the objectives "
+        "are code, telemetry.slo.default_objectives)",
+    )
+    ssub = so.add_subparsers(dest="slo_cmd", required=True)
+    st = ssub.add_parser(
+        "status", help="one status frame: every objective's live "
+        "value, budget remaining, burn rates, and alert state",
+    )
+    _add_slo_source_args(st)
+    st.add_argument("--json", action="store_true",
+                    help="print the raw /slo document (schema v1)")
+    st.set_defaults(fn=_cmd_slo_status)
+    ck = ssub.add_parser(
+        "check", help="gate on the SLO plane: exit 1 if any objective "
+        "is firing (CI runs this after the serving bench so a TPU "
+        "claim can't ship while an SLO burns)",
+    )
+    _add_slo_source_args(ck)
+    ck.add_argument("--json", action="store_true")
+    ck.add_argument(
+        "--strict", action="store_true",
+        help="also fail on objectives in the pending state",
+    )
+    ck.set_defaults(fn=_cmd_slo_check)
+    wa = ssub.add_parser(
+        "watch", help="poll /slo and redraw the status frame",
+    )
+    _add_slo_source_args(wa)
+    wa.add_argument("--interval", type=float, default=2.0,
+                    metavar="SECONDS")
+    wa.add_argument(
+        "--iterations", type=int, default=0, metavar="N",
+        help="stop after N frames (0 = until Ctrl-C)",
+    )
+    wa.set_defaults(fn=_cmd_slo_watch)
+
+
+def _slo_parse_url(url: str) -> tuple[str, int]:
+    if "://" in url and not url.startswith("http://"):
+        # A clear refusal beats the int() parse error https:// would
+        # otherwise surface as.
+        raise ValueError(
+            f"only http:// URLs are supported, got {url!r}"
+        )
+    hostport = url.removeprefix("http://").rstrip("/")
+    host, _, port_s = hostport.partition(":")
+    return host or "127.0.0.1", int(port_s or 8008)
+
+
+def _slo_http_json(url: str, path: str) -> dict:
+    import http.client
+
+    host, port = _slo_parse_url(url)
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+    finally:
+        conn.close()
+    if resp.status != 200:
+        raise OSError(f"GET {path} -> HTTP {resp.status}")
+    return json.loads(body)
+
+
+def _slo_fetch_status(args: argparse.Namespace) -> dict | None:
+    """The /slo document from --report or --url; None (with a message
+    on stderr) when the source is unusable — callers exit 2."""
+    if args.report:
+        try:
+            doc = json.loads(Path(args.report).read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"dsst slo: cannot read --report {args.report}: {e}",
+                  file=sys.stderr)
+            return None
+        if "objectives" not in doc:
+            # A dsst bench --json artifact: the serving scenario embeds
+            # the stub server's /slo snapshot in its extra block.
+            doc = (
+                doc.get("results", {}).get("serving", {})
+                .get("extra", {}).get("slo")
+            )
+        if not isinstance(doc, dict) or "objectives" not in doc:
+            print(
+                f"dsst slo: {args.report} carries no SLO status "
+                "document (expected a /slo JSON or a bench artifact "
+                "with results.serving.extra.slo)",
+                file=sys.stderr,
+            )
+            return None
+        return doc
+    try:
+        return _slo_http_json(args.url, "/slo")
+    except (OSError, ValueError) as e:
+        print(f"dsst slo: cannot scrape {args.url}/slo: {e}",
+              file=sys.stderr)
+        return None
+
+
+def _slo_fmt_value(obj: dict) -> str:
+    v = obj.get("value")
+    if v is None:
+        return "-"
+    if obj.get("unit") == "s":
+        return f"{v * 1000:.1f}ms"
+    return f"{v:.4g}"
+
+
+def _slo_fmt_budget(obj: dict) -> str:
+    b = obj.get("budget")
+    if b is None:
+        return "unarmed"
+    if obj.get("unit") == "s":
+        return f"{b * 1000:g}ms"
+    return f"{b:g}"
+
+
+def _slo_render_text(doc: dict) -> list[str]:
+    rows = [
+        (
+            o["name"], o["state"], _slo_fmt_value(o), _slo_fmt_budget(o),
+            f"{o['burn_fast']:.2f}/{o['burn_slow']:.2f}",
+            ("-" if o.get("budget_remaining") is None
+             else f"{o['budget_remaining']:.2f}"),
+            str(o.get("samples", 0)),
+        )
+        for o in doc.get("objectives", [])
+    ]
+    header = ("OBJECTIVE", "STATE", "VALUE", "BUDGET", "BURN f/s",
+              "REMAINING", "SAMPLES")
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows
+        else len(header[i])
+        for i in range(len(header))
+    ]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths))]
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    firing = doc.get("firing", [])
+    lines.append(
+        "firing: " + (", ".join(firing) if firing else "(none)")
+    )
+    return lines
+
+
+def _cmd_slo_status(args: argparse.Namespace) -> int:
+    doc = _slo_fetch_status(args)
+    if doc is None:
+        return 2
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    else:
+        for line in _slo_render_text(doc):
+            print(line)
+    return 0
+
+
+def _cmd_slo_check(args: argparse.Namespace) -> int:
+    doc = _slo_fetch_status(args)
+    if doc is None:
+        return 2
+    bad = list(doc.get("firing", []))
+    if args.strict:
+        bad += [
+            o["name"] for o in doc.get("objectives", [])
+            if o.get("state") == "pending"
+        ]
+    if args.json:
+        print(json.dumps({
+            "version": doc.get("version", 1),
+            "ok": not bad,
+            "failing": sorted(set(bad)),
+            "objectives": doc.get("objectives", []),
+        }, indent=1))
+    else:
+        for line in _slo_render_text(doc):
+            print(line)
+        print("slo check: "
+              + ("OK" if not bad else "FAILING " + ", ".join(sorted(set(bad)))))
+    return 1 if bad else 0
+
+
+def _cmd_slo_watch(args: argparse.Namespace) -> int:
+    frames = 0
+    try:
+        while True:
+            doc = _slo_fetch_status(args)
+            if doc is None:
+                return 2
+            print("\x1b[2J\x1b[H", end="")
+            print(f"dsst slo watch — {args.report or args.url}  "
+                  f"{time.strftime('%H:%M:%S')}")
+            for line in _slo_render_text(doc):
+                print(line)
+            frames += 1
+            if args.iterations and frames >= args.iterations:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def register_top(sub: argparse._SubParsersAction) -> None:
+    tp = sub.add_parser(
+        "top",
+        help="live terminal view of a serving process: windowed "
+        "latency quantiles, SLO budget remaining, firing alerts, and "
+        "the scheduler/feeder gauges, fused from /slo + /metrics",
+    )
+    tp.add_argument(
+        "--url", default="http://127.0.0.1:8008", metavar="URL",
+        help="the dsst serve process to watch",
+    )
+    tp.add_argument("--interval", type=float, default=2.0,
+                    metavar="SECONDS")
+    tp.add_argument(
+        "--once", action="store_true",
+        help="print one frame and exit (scripting/tests)",
+    )
+    tp.set_defaults(fn=_cmd_top)
+
+
+def _top_parse_metrics(text: str) -> tuple[dict, dict]:
+    """Prometheus text → (plain series, labeled series).
+
+    ``plain`` maps bare series names to floats; ``labeled`` maps name →
+    list of ``(label_dict, value)``.
+    """
+    import re
+
+    plain: dict[str, float] = {}
+    labeled: dict[str, list] = {}
+    label_re = re.compile(r'(\w+)="([^"]*)"')
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        name, _, value_s = line.rpartition(" ")
+        name = name.strip()
+        try:
+            value = float(value_s)
+        except ValueError:
+            continue
+        if "{" in name:
+            base, _, rest = name.partition("{")
+            labels = dict(label_re.findall(rest))
+            labeled.setdefault(base, []).append((labels, value))
+        else:
+            plain[name] = value
+    return plain, labeled
+
+
+_TOP_GAUGES = (
+    "serving_queue_depth",
+    "admission_service_rate_ewma",
+    "admission_est_queue_wait_ms",
+    "slo_alerts_firing",
+)
+
+
+def _top_frame(url: str) -> list[str]:
+    doc = _slo_http_json(url, "/slo")
+    import http.client
+
+    host, port = _slo_parse_url(url)
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    try:
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode()
+    finally:
+        conn.close()
+    plain, labeled = _top_parse_metrics(text)
+
+    lines = [f"dsst top — {url}  {time.strftime('%H:%M:%S')}", ""]
+    lines.extend(_slo_render_text(doc))
+    lines.append("")
+    # Windowed quantile series: every summary family on /metrics (the
+    # window kind renders quantile-labeled samples). The _count join
+    # must follow the same label split: a labeled family's _count line
+    # carries the labels too, so it parses into `labeled`, keyed by
+    # the identical non-quantile label tuple.
+    labeled_counts: dict[tuple[str, tuple], float] = {}
+    for lname, series in labeled.items():
+        if not lname.endswith("_count"):
+            continue
+        for labels, value in series:
+            labeled_counts[
+                (lname[: -len("_count")],
+                 tuple(sorted(labels.items())))
+            ] = value
+    window_rows = []
+    for base, series in sorted(labeled.items()):
+        by_labels: dict[tuple, dict] = {}
+        for labels, value in series:
+            q = labels.get("quantile")
+            if q is None:
+                continue
+            rest = tuple(
+                sorted((k, v) for k, v in labels.items()
+                       if k != "quantile")
+            )
+            by_labels.setdefault(rest, {})[q] = value
+        for rest, qs in sorted(by_labels.items()):
+            label_txt = ",".join(f"{k}={v}" for k, v in rest)
+            name = base + (f"{{{label_txt}}}" if label_txt else "")
+            cells = " ".join(
+                f"p{float(q) * 100:g}="
+                + ("-" if v != v else f"{v * 1000:.2f}ms")
+                for q, v in sorted(qs.items())
+            )
+            count = (
+                labeled_counts.get((base, rest)) if rest
+                else plain.get(f"{base}_count")
+            )
+            window_rows.append(
+                f"  {name:<44} {cells}"
+                + (f"  n={count:g}" if count is not None else "")
+            )
+    if window_rows:
+        lines.append("windows:")
+        lines.extend(window_rows)
+        lines.append("")
+    gauge_cells = [
+        f"{g}={plain[g]:g}" for g in _TOP_GAUGES if g in plain
+    ]
+    if gauge_cells:
+        lines.append("gauges: " + "  ".join(gauge_cells))
+    return lines
+
+
+def _cmd_top(args: argparse.Namespace) -> int:
+    try:
+        while True:
+            try:
+                frame = _top_frame(args.url)
+            except (OSError, ValueError) as e:
+                print(f"dsst top: cannot scrape {args.url}: {e}",
+                      file=sys.stderr)
+                return 2
+            if not args.once:
+                print("\x1b[2J\x1b[H", end="")
+            for line in frame:
+                print(line)
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def register_all(sub: argparse._SubParsersAction) -> None:
     register_datagen(sub)
     register_forecast(sub)
@@ -3184,6 +3573,8 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_audit(sub)
     register_sanitize(sub)
     register_bench(sub)
+    register_slo(sub)
+    register_top(sub)
     from .pipeline import register_pipeline
 
     register_pipeline(sub)

@@ -142,6 +142,17 @@ class Feeder:
             "feeder-thread time to stage + shard + enqueue one batch",
             labels=("feeder",),
         ).labels(feeder=name)
+        # The live half of the stall story: windowed waits (per feeder
+        # on /metrics) plus the SLO engine's aggregate stall-fraction
+        # objective — "are we input-bound NOW", not "were we ever".
+        self._stall_window = telemetry.window(
+            "feeder_stall_window_seconds",
+            "windowed consumer waits on the feeder queue",
+            labels=("feeder",),
+        ).labels(feeder=name)
+        from ..telemetry import slo as _slo
+
+        self._slo_note_wait = _slo.get_engine().note_feeder_wait
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"feeder-{name}"
         )
@@ -222,6 +233,8 @@ class Feeder:
                     raise StopIteration from None
         wait = time.perf_counter() - t0
         self._stall_total.inc(wait)
+        self._stall_window.observe(wait)
+        self._slo_note_wait(wait)
         if self._wait_observer is not None:
             self._wait_observer(wait)
         self._occupancy.set(self._queue.qsize())

@@ -676,11 +676,27 @@ class Trainer:
                 "train_step executable compiles (first step + retraces)",
             ),
         )
-        step_timer = StepTimer(
-            observer=telemetry.SampledObserver(
-                step_hist, _HIST_SAMPLE_EVERY
-            ).observe
+        # Step times feed three sinks: the sampled cumulative histogram
+        # (cheap long-run distribution), the sliding-window sketch
+        # (live p95 on /metrics), and the SLO engine's step-time
+        # objective. The window/SLO observes are full-rate on purpose —
+        # a windowed p95 sampled 1-in-8 would lag exactly the
+        # regressions it exists to catch — and each costs one bisect.
+        _sampled_step = telemetry.SampledObserver(
+            step_hist, _HIST_SAMPLE_EVERY
+        ).observe
+        _step_window = telemetry.window(
+            "train_step_window_seconds",
+            "windowed wall time between dispatched train steps",
         )
+        _slo_note_step = telemetry.slo.get_engine().note_train_step
+
+        def _observe_step(dt: float) -> None:
+            _sampled_step(dt)
+            _step_window.observe(dt)
+            _slo_note_step(dt)
+
+        step_timer = StepTimer(observer=_observe_step)
         tracing = False
         preempted = False
         guard = PreemptionGuard()

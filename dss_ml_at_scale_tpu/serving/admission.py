@@ -194,6 +194,21 @@ class AdmissionController:
         with self._lock:
             return self._pending
 
+    @property
+    def service_rate_ewma(self) -> float:
+        """The measured seconds-per-image EWMA (what Retry-After is
+        computed from) — exported so the live monitoring plane can see
+        the controller's internal model instead of inferring it."""
+        with self._lock:
+            return self._seconds_per_image
+
+    @property
+    def est_queue_wait_s(self) -> float:
+        """Estimated wait for a newly admitted image: everything
+        already pending, at the measured service rate."""
+        with self._lock:
+            return self._pending * self._seconds_per_image
+
     def note_service_rate(self, seconds_per_image: float) -> None:
         """EWMA of measured scoring cost, feeding Retry-After."""
         with self._lock:

@@ -111,10 +111,32 @@ class ServingScheduler:
         self._batches = telemetry.counter(
             "serving_batches_total", "scored micro-batches"
         )
+        # The admission controller's internal model, exported: the
+        # service-rate EWMA and the queue-wait estimate used to be
+        # private state only a 429's Retry-After ever revealed; the
+        # self-tuning controller (ROADMAP item 5) and `dsst top` need
+        # them as live gauges.
+        self._svc_rate_gauge = telemetry.gauge(
+            "admission_service_rate_ewma",
+            "admission controller's EWMA of scorer seconds per image",
+        )
+        self._queue_wait_gauge = telemetry.gauge(
+            "admission_est_queue_wait_ms",
+            "estimated queue wait for a newly admitted image "
+            "(pending x service-rate EWMA)",
+        )
 
         self._admission = AdmissionController(
             self.config.queue_depth, on_depth=self._queue_gauge.set
         )
+        if self.config.deadline_ms > 0:
+            # Arm the latency objective with the real budget: the SLO
+            # plane judges requests against the deadline clients see.
+            from ..telemetry import slo as slo_mod
+
+            slo_mod.get_engine().set_latency_budget(
+                self.config.deadline_ms / 1000.0
+            )
         self._decode_q: queue.Queue = queue.Queue()
         self._batch_q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
@@ -332,6 +354,12 @@ class ServingScheduler:
             return
         score_dur = time.perf_counter() - t0
         self._admission.note_service_rate(score_dur / len(items))
+        # Sampled exactly where the EWMA is fed: the gauges track the
+        # controller's model batch-for-batch, no separate poller.
+        self._svc_rate_gauge.set(self._admission.service_rate_ewma)
+        self._queue_wait_gauge.set(
+            self._admission.est_queue_wait_s * 1000.0
+        )
         self._batch_fill.observe(len(items))
         self._batches.inc()
         # One coalesced batch serves many requests; each traced request

@@ -28,8 +28,9 @@ from .registry import (
     log_buckets,
 )
 from .spans import SpanLog, export_perfetto, to_perfetto
-from . import flightrec, tracecontext
+from . import flightrec, slo, tracecontext, windows
 from .tracecontext import Handoff, TraceContext
+from .windows import SlidingQuantile, WindowedCounter, quantile
 
 __all__ = [
     "CompileTracker",
@@ -39,8 +40,10 @@ __all__ = [
     "MetricFamily",
     "MetricsRegistry",
     "SampledObserver",
+    "SlidingQuantile",
     "SpanLog",
     "TraceContext",
+    "WindowedCounter",
     "collect_remote_snapshots",
     "counter",
     "device_memory_stats",
@@ -51,13 +54,17 @@ __all__ = [
     "get_span_log",
     "histogram",
     "log_buckets",
+    "quantile",
     "render_prometheus",
     "reset",
     "rpc_handlers",
+    "slo",
     "snapshot",
     "span",
     "to_perfetto",
     "tracecontext",
+    "window",
+    "windows",
     "write_exports",
 ]
 
@@ -88,6 +95,13 @@ def histogram(name: str, help: str = "", labels=(),
     return _registry.histogram(name, help, labels, buckets)
 
 
+def window(name: str, help: str = "", labels=(), window_s=None,
+           quantiles=None) -> MetricFamily:
+    """A sliding-window quantile series on the default registry (live
+    p50/p99/rate/max over the last ``window_s`` seconds)."""
+    return _registry.window(name, help, labels, window_s, quantiles)
+
+
 def span(name: str, **args):
     """``with telemetry.span("decode"): ...`` on the default span log."""
     return _span_log.span(name, **args)
@@ -102,9 +116,11 @@ def render_prometheus() -> str:
 
 
 def reset() -> None:
-    """Zero every default-registry series and clear the span log.
+    """Zero every default-registry series, clear the span log, and
+    reset the SLO engine's windows/alert states.
 
     Test isolation and epoch-boundary resets; registrations survive.
     """
     _registry.reset()
     _span_log.clear()
+    slo.reset()

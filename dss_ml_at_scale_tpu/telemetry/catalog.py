@@ -24,7 +24,7 @@ fails on either alone). Removing one: remove both.
 
 from __future__ import annotations
 
-# name -> kind ("counter" | "gauge" | "histogram")
+# name -> kind ("counter" | "gauge" | "histogram" | "window")
 KNOWN_METRICS: dict[str, str] = {
     # -- analysis ----------------------------------------------------------
     "audit_entrypoints_total": "counter",
@@ -77,6 +77,14 @@ KNOWN_METRICS: dict[str, str] = {
     "train_data_wait_seconds": "histogram",
     "train_step_seconds": "histogram",
     "train_throughput_rows_per_sec": "gauge",
+    # -- live SLO plane ----------------------------------------------------
+    "admission_est_queue_wait_ms": "gauge",
+    "admission_service_rate_ewma": "gauge",
+    "feeder_stall_window_seconds": "window",
+    "serving_request_window_seconds": "window",
+    "slo_alert_transitions_total": "counter",
+    "slo_alerts_firing": "gauge",
+    "train_step_window_seconds": "window",
     # -- serving -----------------------------------------------------------
     "predict_batch_seconds": "histogram",
     "predict_errors_total": "counter",
@@ -130,6 +138,27 @@ KNOWN_SPANS: dict[str, str] = {
                   "fit the full order grid, device argmin",
     # -- ingest ------------------------------------------------------------
     "ingest": "one ingest run over a raw image tree",
+    # -- SLO ---------------------------------------------------------------
+    "slo.alert": "one burn-rate alert state transition (recorded under "
+                 "the worst offender's trace id, so the Perfetto export "
+                 "draws a flow arrow to the offending request/step)",
+}
+
+# SLO objective name -> what the objective covers. The ``slo-registry``
+# lint rule (``dsst lint``) reconciles the ``Objective(name=...)``
+# declarations in ``telemetry/slo.py`` (and every literal objective
+# name at ``set_target(...)`` call sites) against this in both
+# directions — a typo'd objective would otherwise silently declare a
+# NEW budget nobody alerts on, exactly the series-forking failure mode
+# KNOWN_METRICS guards against.
+KNOWN_SLOS: dict[str, str] = {
+    "serving_latency_p99": "admitted requests settle inside the latency "
+                           "budget (the configured deadline)",
+    "serving_error_rate": "requests answered without 429/503/5xx",
+    "feeder_stall_fraction": "step-loop wall time blocked on the feeder "
+                             "queue stays under 1%",
+    "train_step_p95": "windowed p95 train-step seconds vs the armed "
+                      "step budget",
 }
 
 # Span name -> attribution bucket: where a step's wall time went. The
@@ -194,5 +223,12 @@ KNOWN_BENCH_METRICS: dict[str, tuple[str, ...]] = {
         "serving_p50_ms",
         "serving_p99_ms",
         "serving_batch_fill_mean",
+        "serving_live_p99_ms",
+    ),
+    "slo_overhead": (
+        "slo_sketch_observe_us",
+        "slo_hist_observe_us",
+        "slo_overhead_ratio",
+        "slo_emit_step_fraction",
     ),
 }

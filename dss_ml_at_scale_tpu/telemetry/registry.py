@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Mapping, Sequence
 
+from . import windows as _windows
+
 
 def log_buckets(
     lo: float = 1e-6, hi: float = 100.0, per_decade: int = 3
@@ -154,6 +156,37 @@ class _HistogramValue:
         return {"count": total, "sum": s, "buckets": out}
 
 
+class _WindowValue:
+    """One windowed series: a sliding-window quantile sketch.
+
+    The fourth registry kind (``window``): constant-memory live
+    quantiles/rate/mean/max over the last ``window_s`` seconds
+    (:class:`~dss_ml_at_scale_tpu.telemetry.windows.SlidingQuantile`).
+    Renders as a Prometheus *summary* on ``/metrics`` — with the
+    non-standard but documented semantics that the quantiles and
+    ``_sum``/``_count`` cover only the window, not the process
+    lifetime. The sketch carries its own lock; no state lives here.
+    """
+
+    __slots__ = ("_sketch", "_quantiles")
+
+    def __init__(self, window_s: float, quantiles: Sequence[float]):
+        self._sketch = _windows.SlidingQuantile(window_s=window_s)
+        self._quantiles = tuple(quantiles)
+
+    def observe(self, v: float, trace: str | None = None) -> None:
+        self._sketch.observe(v, trace=trace)
+
+    def quantile(self, q: float) -> float | None:
+        return self._sketch.quantile(q)
+
+    def _reset(self) -> None:
+        self._sketch.reset()
+
+    def _sample(self) -> dict:
+        return self._sketch.snapshot(self._quantiles)
+
+
 _CHILD_TYPES = {
     "counter": _CounterValue,
     "gauge": _GaugeValue,
@@ -203,7 +236,8 @@ class MetricFamily:
     _guarded_by_lock = ("_children",)
 
     def __init__(self, kind: str, name: str, help: str = "",
-                 label_names: Sequence[str] = (), buckets=None):
+                 label_names: Sequence[str] = (), buckets=None,
+                 window_s: float | None = None, quantiles=None):
         self.kind = kind
         self.name = name
         self.help = help
@@ -216,6 +250,19 @@ class MetricFamily:
             self._buckets = DEFAULT_BUCKETS
         else:
             self._buckets = None
+        # Window geometry, resolved at registration for the same reason.
+        if kind == "window":
+            self._window_s = float(
+                window_s if window_s is not None
+                else _windows.DEFAULT_WINDOW_S
+            )
+            self._quantiles = tuple(
+                quantiles if quantiles is not None
+                else _windows.DEFAULT_QUANTILES
+            )
+        else:
+            self._window_s = None
+            self._quantiles = None
         self._lock = threading.Lock()
         self._children: dict[tuple[str, ...], object] = {}
         if not self.label_names:
@@ -223,13 +270,15 @@ class MetricFamily:
             self._children[()] = solo
             # Bind the child's mutators directly: the unlabeled hot path
             # pays zero indirection.
-            for m in ("inc", "dec", "set", "observe"):
+            for m in ("inc", "dec", "set", "observe", "quantile"):
                 if hasattr(solo, m):
                     setattr(self, m, getattr(solo, m))
 
     def _new_child(self):
         if self.kind == "histogram":
             return _HistogramValue(self._buckets)
+        if self.kind == "window":
+            return _WindowValue(self._window_s, self._quantiles)
         return _CHILD_TYPES[self.kind]()
 
     def labels(self, **labels: str):
@@ -286,12 +335,14 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._families: dict[str, MetricFamily] = {}
 
-    def _get(self, kind: str, name: str, help: str, labels, buckets=None):
+    def _get(self, kind: str, name: str, help: str, labels, buckets=None,
+             window_s=None, quantiles=None):
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
                 fam = self._families[name] = MetricFamily(
-                    kind, name, help, labels, buckets
+                    kind, name, help, labels, buckets,
+                    window_s=window_s, quantiles=quantiles,
                 )
                 return fam
         if fam.kind != kind:
@@ -313,6 +364,17 @@ class MetricsRegistry:
                 f"histogram {name!r} already registered with buckets "
                 f"{fam._buckets}, requested {tuple(buckets)}"
             )
+        if kind == "window":
+            if window_s is not None and float(window_s) != fam._window_s:
+                raise ValueError(
+                    f"window {name!r} already registered with "
+                    f"window_s={fam._window_s}, requested {window_s}"
+                )
+            if quantiles is not None and tuple(quantiles) != fam._quantiles:
+                raise ValueError(
+                    f"window {name!r} already registered with quantiles "
+                    f"{fam._quantiles}, requested {tuple(quantiles)}"
+                )
         return fam
 
     def counter(self, name: str, help: str = "",
@@ -327,6 +389,16 @@ class MetricsRegistry:
                   labels: Sequence[str] = (),
                   buckets: Sequence[float] | None = None) -> MetricFamily:
         return self._get("histogram", name, help, labels, buckets)
+
+    def window(self, name: str, help: str = "",
+               labels: Sequence[str] = (),
+               window_s: float | None = None,
+               quantiles: Sequence[float] | None = None) -> MetricFamily:
+        """A sliding-window quantile series (live p50/p99/rate/max over
+        the last ``window_s`` seconds) — the windowed sibling of
+        :meth:`histogram`."""
+        return self._get("window", name, help, labels,
+                         window_s=window_s, quantiles=quantiles)
 
     def families(self) -> list[MetricFamily]:
         with self._lock:
@@ -361,9 +433,27 @@ class MetricsRegistry:
         for fam in self.families():
             if fam.help:
                 lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
-            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            # The window kind renders as a Prometheus summary whose
+            # quantiles/_sum/_count cover only the sliding window.
+            kind_txt = "summary" if fam.kind == "window" else fam.kind
+            lines.append(f"# TYPE {fam.name} {kind_txt}")
             for labels, sample in fam._series():
-                if fam.kind == "histogram":
+                if fam.kind == "window":
+                    for q, v in sample["quantiles"].items():
+                        lines.append(
+                            f"{fam.name}"
+                            f"{_labels_text({**labels, 'quantile': q})} "
+                            f"{_fmt(v if v is not None else math.nan)}"
+                        )
+                    lines.append(
+                        f"{fam.name}_sum{_labels_text(labels)} "
+                        f"{_fmt(sample['sum'])}"
+                    )
+                    lines.append(
+                        f"{fam.name}_count{_labels_text(labels)} "
+                        f"{sample['count']}"
+                    )
+                elif fam.kind == "histogram":
                     # _sample() pairs are already cumulative (le semantics).
                     for le, c in sample["buckets"]:
                         lines.append(
@@ -388,6 +478,8 @@ class MetricsRegistry:
 
 def _fmt(v: float) -> str:
     """Float formatting shared by the text renderer and bucket keys."""
+    if v != v:
+        return "NaN"  # Prometheus spelling for an empty-window quantile
     if v == math.inf:
         return "+Inf"
     if v == -math.inf:

@@ -156,6 +156,15 @@ class RunStore:
 
         self._trace_path = flightrec.enable(self.path / "flightrec.jsonl")
         self.journal_event("trace", path=str(self._trace_path))
+        # SLO alert transitions journal into the run directory through
+        # the same crash-durable appender: if this run dies with an
+        # alert firing, the doctor can say so from disk alone.
+        from ..telemetry import slo as slo_mod
+
+        self._alerts_path = slo_mod.get_engine().attach_journal(
+            self.path / "alerts.jsonl"
+        )
+        self.journal_event("slo_journal", path=str(self._alerts_path))
 
     # -- logging ----------------------------------------------------------
 
@@ -275,6 +284,11 @@ class RunStore:
         from ..telemetry import flightrec
 
         flightrec.disable(self._trace_path)
+        # Same scoping rule for the alert journal: detach only if the
+        # engine still targets THIS run's file.
+        from ..telemetry import slo as slo_mod
+
+        slo_mod.get_engine().detach_journal(self._alerts_path)
 
     # -- context manager (finish() may never run on a hard crash; `with`
     # scopes the metrics handle to the block and stamps the outcome) ------
@@ -380,6 +394,8 @@ def classify_run(run_dir: str | os.PathLike) -> dict:
         "cmdline": None,
         "cwd": None,
         "trace_file": None,
+        "alerts_file": None,
+        "firing_alerts": [],
         "heartbeat_age_s": None,
     }
     try:
@@ -404,6 +420,8 @@ def classify_run(run_dir: str | os.PathLike) -> dict:
             # The flight-recorder tail this run's writer recorded into —
             # where a dead run's last (and in-flight) spans live.
             out["trace_file"] = e.get("path")
+        elif e["event"] == "slo_journal":
+            out["alerts_file"] = e.get("path")
         elif e["event"] in ("checkpoint", "manifest_repair"):
             out["last_step"] = e.get("step")
             out["checkpoint_dir"] = e.get("checkpoint_dir")
@@ -412,6 +430,13 @@ def classify_run(run_dir: str | os.PathLike) -> dict:
         out["heartbeat_age_s"] = round(_now() - journal.stat().st_mtime, 1)
     except OSError:
         pass
+    if out["alerts_file"]:
+        # Alerts whose LAST journaled transition left them firing: for
+        # a dead run this is "what was burning when it died"; for a
+        # live one, what is burning now.
+        from ..telemetry import slo as slo_mod
+
+        out["firing_alerts"] = slo_mod.firing_at_death(out["alerts_file"])
     if out["status"] in TERMINAL_STATUSES:
         out["effective_status"] = out["status"]
         return out

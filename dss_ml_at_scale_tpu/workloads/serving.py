@@ -261,12 +261,34 @@ def make_server(predictor, host: str = "127.0.0.1",
     error_counter = telemetry.counter(
         "serving_errors_total", "HTTP 4xx/5xx responses", labels=("code",)
     )
+    # The live half of the latency story: a sliding-window quantile
+    # sketch next to the cumulative histogram, so /metrics can answer
+    # "what is p99 NOW" instead of "what was p99 since boot".
+    request_window = telemetry.window(
+        "serving_request_window_seconds",
+        "live windowed /predict latency (quantiles over the window, "
+        "rendered as a summary)",
+    )
+    slo_engine = telemetry.slo.get_engine()
 
     lifecycle = Lifecycle()
     scheduler = ServingScheduler(predictor, config, lifecycle=lifecycle)
     access = JsonlWriter(access_log) if access_log else None
+    _deadline_ms = scheduler.config.deadline_ms
 
-    _known_paths = frozenset(("/healthz", "/readyz", "/metrics", "/predict"))
+    _known_paths = frozenset(
+        ("/healthz", "/readyz", "/metrics", "/slo", "/predict")
+    )
+
+    def _deadline_met(latency_ok: bool | None) -> bool | None:
+        """Did this request beat the armed deadline? Reuses the SAME
+        latency classification the SLO objective aggregated (so the
+        two row fields can never contradict each other); None when no
+        deadline is configured, or when the request never reached a
+        scoring verdict (429 refused at the door, 4xx client errors)."""
+        if _deadline_ms <= 0:
+            return None
+        return latency_ok
 
     class Handler(BaseHTTPRequestHandler):
         # HTTP/1.1 with exact Content-Length everywhere → keep-alive:
@@ -349,6 +371,12 @@ def make_server(predictor, host: str = "127.0.0.1",
                                          "state": lifecycle.state})
                 elif self.path == "/metrics":
                     self._metrics()
+                elif self.path == "/slo":
+                    # The judging plane next to the measuring plane:
+                    # every declared objective's live value, burn
+                    # rates, and alert state (schema v1 — what
+                    # `dsst slo` and `dsst top` consume).
+                    self._json(200, slo_engine.render_status())
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
             finally:
@@ -362,15 +390,39 @@ def make_server(predictor, host: str = "127.0.0.1",
                 self._post()
             finally:
                 self._observe(t0)
+                dur_s = time.perf_counter() - t0
+                status = self._last_code
+                latency_ok = verdict = None
+                if self.path == "/predict" and status is not None:
+                    # Feed the live plane: the windowed sketch (what
+                    # /metrics renders as the summary quantiles) and the
+                    # SLO engine's latency/error objectives, each
+                    # carrying the request's trace id so a burn-rate
+                    # alert can point at its worst offender.
+                    # note_request returns THE shared classification
+                    # (telemetry.slo.classify_request) — the access-log
+                    # row reuses it, so the journaled per-request
+                    # ground truth and the live objective can never
+                    # judge the same request differently (and the
+                    # request is classified exactly once).
+                    request_window.observe(dur_s, trace=self._trace_id)
+                    _, latency_ok, verdict = slo_engine.note_request(
+                        dur_s, status, trace_id=self._trace_id
+                    )
                 if access is not None and self.path == "/predict":
                     info = self._req_info or {}
                     access.write({
                         "ts": round(time.time(), 3),
                         "request_id": self._trace_id,
-                        "status": self._last_code,
+                        "status": status,
                         "images": self._req_images,
+                        "latency_ms": round(dur_s * 1000.0, 3),
                         "queue_ms": info.get("queue_ms"),
                         "batch_fill": info.get("batch_fill"),
+                        # Per-request SLO ground truth — what the
+                        # windowed latency objective aggregates.
+                        "deadline_met": _deadline_met(latency_ok),
+                        "slo": verdict,
                     })
 
         def _post(self):

@@ -48,6 +48,22 @@ if ! JAX_PLATFORMS=cpu timeout 2100 python -m dss_ml_at_scale_tpu.config.cli ben
   echo "preflight FAILED: dsst bench tier1 regressed - refusing to spend the TPU claim"
   exit 1
 fi
+# Live-SLO gate (fifth tier's judging half): rerun the serving scenario
+# with a JSON artifact and judge the stub server's embedded /slo
+# snapshot. Baseline-free: the objectives are code
+# (telemetry/slo.py default_objectives), so there is nothing to pin.
+# --strict on purpose: the bench's ~5s of load is shorter than the 10s
+# pending->firing debounce, so "firing" is unreachable here — a burning
+# objective shows as "pending" in the snapshot, and that is the state
+# this gate must refuse on.
+if ! JAX_PLATFORMS=cpu timeout 600 python -m dss_ml_at_scale_tpu.config.cli bench --scenarios serving --json > /tmp/dsst_bench_serving_slo.json; then
+  echo "preflight FAILED: serving bench for slo check - refusing to spend the TPU claim"
+  exit 1
+fi
+if ! JAX_PLATFORMS=cpu timeout 120 python -m dss_ml_at_scale_tpu.config.cli slo check --strict --report /tmp/dsst_bench_serving_slo.json; then
+  echo "preflight FAILED: dsst slo check found a burning objective - refusing to spend the TPU claim"
+  exit 1
+fi
 
 echo "== probe =="
 timeout 150 python - <<'EOF'

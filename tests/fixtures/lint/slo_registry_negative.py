@@ -1,0 +1,15 @@
+"""slo-registry negative fixture: clean against
+``known={"serving_latency_p99": "..."}``."""
+
+
+def build(engine):
+    obj = Objective(
+        name="serving_latency_p99", description="", kind="events",
+        target=0.99,
+    )
+    engine.set_target("serving_latency_p99", 0.95)
+    # A suppressed computed name carries its audit trail in source:
+    # dsst: ignore[slo-registry] test-harness objective built from a parametrized name
+    dynamic = Objective(name=f"{obj.name}_shadow", description="",
+                        kind="events", target=0.5)
+    return dynamic

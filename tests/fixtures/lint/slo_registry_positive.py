@@ -1,0 +1,27 @@
+"""slo-registry positive fixture: 4 findings expected.
+
+Checker is constructed with
+``known={"serving_latency_p99": "...", "dead_slo": "..."}``:
+an undeclared Objective name, a non-literal Objective name, an
+undeclared set_target reference, and the dead ``dead_slo`` catalog
+entry (finalize).
+"""
+
+
+def build(engine, make_name):
+    objs = [
+        # undeclared objective name -> finding
+        Objective(name="typo_objective", description="", kind="events",
+                  target=0.99),
+        # non-literal name -> finding
+        Objective(name=make_name(), description="", kind="events",
+                  target=0.99),
+        # declared: keeps serving_latency_p99 alive
+        Objective(name="serving_latency_p99", description="",
+                  kind="events", target=0.99),
+    ]
+    # undeclared reference -> finding
+    engine.set_target("unknown_slo", 1.0)
+    # declared reference: clean
+    engine.set_target("serving_latency_p99", 0.95)
+    return objs

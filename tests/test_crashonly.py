@@ -152,10 +152,13 @@ def test_journal_classifies_live_finished_and_interrupted(tmp_path):
     run.finish()
     assert classify_run(run.path)["effective_status"] == "FINISHED"
     events = [e["event"] for e in read_journal(run.path)]
-    # "trace" right after "start": every run registers its flight-
-    # recorder tail in the journal (what classify_run's trace_file and
-    # `dsst trace --run` resolve).
-    assert events == ["start", "trace", "checkpoint", "finish"]
+    # "trace" + "slo_journal" right after "start": every run registers
+    # its flight-recorder tail AND its SLO alert journal (what
+    # classify_run's trace_file/alerts_file and `dsst trace --run` /
+    # `runs doctor`'s firing-at-death surfacing resolve).
+    assert events == [
+        "start", "trace", "slo_journal", "checkpoint", "finish",
+    ]
     assert classify_run(run.path)["trace_file"] == str(
         (run.path / "flightrec.jsonl").absolute()
     )

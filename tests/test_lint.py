@@ -50,6 +50,9 @@ from dss_ml_at_scale_tpu.analysis.checkers.no_print import NoPrintChecker
 from dss_ml_at_scale_tpu.analysis.checkers.retrace_hazard import (
     RetraceHazardChecker,
 )
+from dss_ml_at_scale_tpu.analysis.checkers.slo_registry import (
+    SloRegistryChecker,
+)
 from dss_ml_at_scale_tpu.analysis.checkers.span_discipline import (
     SpanDisciplineChecker,
 )
@@ -166,6 +169,16 @@ RULES = {
         lambda: BenchRegistryChecker(known={
             "decode": ("decode_images_per_sec",),
             "kwform": ("a_metric",),
+        }), None,
+    ),
+    "slo_registry_pos": (
+        lambda: SloRegistryChecker(known={
+            "serving_latency_p99": "latency", "dead_slo": "unmeasured",
+        }), 4,
+    ),
+    "slo_registry_neg": (
+        lambda: SloRegistryChecker(known={
+            "serving_latency_p99": "latency",
         }), None,
     ),
 }

@@ -461,7 +461,21 @@ def test_access_log_rows_for_200_429_503(tmp_path):
     assert sorted(r["request_id"] for r in rows) == sorted(headers)
     for r in rows:
         assert r["images"] == 1
+        # Per-request SLO ground truth (what the windowed latency
+        # objective aggregates): a 200 beat the armed 40ms deadline by
+        # construction, a 503 is a deadline miss, a 429 never reached
+        # a scoring verdict.
+        assert {"deadline_met", "slo"} <= r.keys()
         if r["status"] == 200:
             assert r["queue_ms"] >= 0 and r["batch_fill"] >= 1
+            # Both fields come from ONE classification of the
+            # HTTP-observed latency (what the client saw, which starts
+            # slightly before admission) — they can never contradict.
+            met = r["latency_ms"] <= 40.0
+            assert r["deadline_met"] is met
+            assert r["slo"] == ("ok" if met else "breach")
+        if r["status"] == 503:
+            assert r["deadline_met"] is False and r["slo"] == "breach"
         if r["status"] == 429:
             assert r["batch_fill"] is None  # never entered the pipeline
+            assert r["deadline_met"] is None and r["slo"] == "breach"

@@ -1,0 +1,243 @@
+"""Sliding-window primitives: the shared quantile helper, the sketch's
+accuracy bounds across rotation and merge, and its thread-safety.
+
+The accuracy property is the tentpole claim: the live windowed p99 and
+the offline loadgen p99 share ONE quantile definition
+(``telemetry.windows.quantile``), so the sketch may differ from
+``numpy.percentile`` only by its bounded bucket error (one log-bucket's
+relative width, 10^(1/9) ≈ 1.29 at the default edges) plus a small
+rank error set by bucket occupancy.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from dss_ml_at_scale_tpu import telemetry
+from dss_ml_at_scale_tpu.telemetry.registry import MetricsRegistry
+from dss_ml_at_scale_tpu.telemetry.windows import (
+    SlidingQuantile,
+    WindowedCounter,
+    quantile,
+)
+
+# One bucket's relative width at the default sketch edges (9/decade).
+BUCKET_RATIO = 10 ** (1 / 9) + 0.01
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# -- the shared quantile definition -------------------------------------------
+
+
+def test_quantile_matches_numpy_percentile():
+    rng = np.random.default_rng(7)
+    for n in (1, 2, 5, 100, 1001):
+        xs = rng.lognormal(-3, 1.0, n)
+        for q in (0.0, 0.25, 0.5, 0.9, 0.95, 0.99, 1.0):
+            assert quantile(xs, q) == pytest.approx(
+                float(np.percentile(xs, q * 100)), rel=1e-12
+            ), (n, q)
+
+
+def test_quantile_rejects_empty_and_bad_q():
+    with pytest.raises(ValueError):
+        quantile([], 0.5)
+    with pytest.raises(ValueError):
+        quantile([1.0], 1.5)
+
+
+def test_offline_consumers_import_the_one_helper():
+    """The single-sourcing satellite: bench/loadgen.py and
+    bench/stats.py percentile math IS telemetry.windows.quantile, and
+    the values pin exactly on a fixed sample set."""
+    from dss_ml_at_scale_tpu.bench import loadgen, stats
+
+    assert loadgen.quantile is quantile
+    assert stats.quantile is quantile
+    fixed = [0.010, 0.020, 0.030, 0.040, 0.100]
+    # Pinned values (linear interpolation between closest ranks).
+    assert quantile(fixed, 0.5) == pytest.approx(0.030)
+    assert quantile(fixed, 0.99) == pytest.approx(0.09760)
+    assert stats.median(fixed) == quantile(fixed, 0.5)
+    # Even-n median is the classic midpoint — stats.median's old
+    # definition, preserved through the delegation.
+    assert stats.median([1.0, 2.0, 3.0, 4.0]) == 2.5
+
+
+# -- sketch accuracy: property test vs numpy across rotation & merge ----------
+
+
+def _rank_of(xs: np.ndarray, v: float) -> float:
+    return float(np.searchsorted(np.sort(xs), v) / len(xs))
+
+
+@pytest.mark.parametrize("sigma", [0.6, 1.5])
+def test_sketch_quantiles_bounded_error_across_rotation(sigma):
+    clock = FakeClock()
+    sk = SlidingQuantile(window_s=60.0, sub_windows=6, clock=clock)
+    rng = np.random.default_rng(int(sigma * 10))
+    batches = []
+    # Three bursts spread across sub-windows: reads merge 3 digests.
+    for t in (0.0, 12.0, 24.0):
+        clock.t = t
+        xs = rng.lognormal(-4, sigma, 800)
+        batches.append(xs)
+        for v in xs:
+            sk.observe(float(v))
+    live = np.concatenate(batches)
+    for q in (0.5, 0.9, 0.99):
+        est = sk.quantile(q)
+        exact = float(np.percentile(live, q * 100))
+        assert 1 / BUCKET_RATIO <= est / exact <= BUCKET_RATIO, (q, est, exact)
+        # Bounded RANK error too: the estimate's empirical rank sits
+        # near q (bucket-occupancy bound; generous for the tail).
+        assert abs(_rank_of(live, est) - q) <= 0.06, (q, est)
+    assert sk.count() == len(live)
+
+    # Rotate past the first burst: the window now spans only what the
+    # live ring kept — the old samples must stop influencing the read.
+    clock.t = 24.0 + 61.0
+    assert sk.count() == 0
+    clock.t = 100.0
+    xs = rng.lognormal(-2, sigma, 500)
+    for v in xs:
+        sk.observe(float(v))
+    clock.t = 130.0  # mid-window: same burst still fully covered
+    for q in (0.5, 0.99):
+        est = sk.quantile(q)
+        exact = float(np.percentile(xs, q * 100))
+        assert 1 / BUCKET_RATIO <= est / exact <= BUCKET_RATIO, (q, est, exact)
+
+
+def test_sketch_snapshot_and_worst_trace():
+    clock = FakeClock()
+    sk = SlidingQuantile(window_s=30.0, clock=clock)
+    sk.observe(0.010, trace="aaaa")
+    sk.observe(0.500, trace="deadbeef")
+    sk.observe(0.020, trace="bbbb")
+    snap = sk.snapshot()
+    assert snap["count"] == 3
+    assert snap["max"] == 0.5 and snap["min"] == 0.010
+    assert snap["mean"] == pytest.approx((0.01 + 0.5 + 0.02) / 3)
+    assert set(snap["quantiles"]) == {"0.5", "0.9", "0.99"}
+    assert sk.worst_trace() == "deadbeef"
+    sk.reset()
+    assert sk.count() == 0 and sk.quantile(0.5) is None
+
+
+def test_windowed_counter_rotation_and_rate():
+    clock = FakeClock()
+    wc = WindowedCounter(window_s=30.0, sub_windows=6, clock=clock)
+    wc.add(6.0)
+    clock.t = 10.0
+    wc.add(6.0)
+    assert wc.total() == 12.0
+    # rate() divides by covered wall time (clamped at the window).
+    assert wc.rate() == pytest.approx(12.0 / 10.0)
+    clock.t = 50.0
+    # 50s after birth: the t=0 slot expired, the t=10 slot (sub-window
+    # [5,10)... expiry is by sub-window granularity) may too — total
+    # only ever shrinks toward the live window's content.
+    assert wc.total() <= 6.0
+    clock.t = 200.0
+    assert wc.total() == 0.0
+
+
+def test_sketch_concurrent_observers_and_readers():
+    """Thread-safety under the sanitizer-armed session: concurrent
+    observers and a quantile reader race the same sketch; every
+    observation lands, no torn digest."""
+    sk = SlidingQuantile(window_s=300.0)
+    n_threads, per_thread = 4, 4000
+    errors: list[BaseException] = []
+
+    def observe(seed: int) -> None:
+        try:
+            for i in range(per_thread):
+                sk.observe(0.001 * ((seed + i) % 97 + 1))
+        except BaseException as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    def read() -> None:
+        try:
+            for _ in range(300):
+                sk.quantile(0.99)
+                sk.snapshot()
+        except BaseException as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=observe, args=(s,)) for s in range(n_threads)
+    ] + [threading.Thread(target=read)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert sk.count() == n_threads * per_thread
+    assert 0.001 <= sk.quantile(0.5) <= 0.097
+
+
+# -- the registry's window kind ----------------------------------------------
+
+
+def test_registry_window_kind_renders_summary_and_snapshot():
+    reg = MetricsRegistry()
+    fam = reg.window("req_window_seconds", "test", window_s=45.0)
+    fam.observe(0.010)
+    fam.observe(0.020, trace="cafe")
+    text = reg.render_prometheus()
+    assert "# TYPE req_window_seconds summary" in text
+    assert 'req_window_seconds{quantile="0.99"}' in text
+    assert "req_window_seconds_count 2" in text
+    snap = reg.snapshot()["metrics"][0]
+    assert snap["type"] == "window"
+    assert snap["count"] == 2 and snap["window_s"] == 45.0
+    # Empty windows render NaN quantiles (valid Prometheus), null JSON.
+    reg2 = MetricsRegistry()
+    reg2.window("empty_window", "t")
+    assert 'empty_window{quantile="0.5"} NaN' in reg2.render_prometheus()
+    import json
+
+    json.dumps(reg2.snapshot())  # must stay JSON-serializable
+
+
+def test_registry_window_geometry_mismatch_raises():
+    reg = MetricsRegistry()
+    reg.window("w", "t", window_s=30.0)
+    with pytest.raises(ValueError):
+        reg.window("w", "t", window_s=60.0)
+    with pytest.raises(ValueError):
+        reg.window("w", "t", quantiles=(0.5,))
+    reg.window("w", "t")  # unspecified geometry: reuses the family
+
+
+def test_registry_window_labeled_children():
+    reg = MetricsRegistry()
+    fam = reg.window("labeled_w", "t", labels=("feeder",))
+    fam.labels(feeder="train").observe(0.5)
+    fam.labels(feeder="eval").observe(0.1)
+    text = reg.render_prometheus()
+    assert 'labeled_w{feeder="train",quantile="0.5"}' in text
+    assert 'labeled_w_count{feeder="eval"} 1' in text
+
+
+def test_telemetry_reset_clears_window_series():
+    fam = telemetry.window("reset_probe_window", "t")
+    fam.observe(1.0)
+    telemetry.reset()
+    snap = next(
+        m for m in telemetry.snapshot()["metrics"]
+        if m["name"] == "reset_probe_window"
+    )
+    assert snap["count"] == 0

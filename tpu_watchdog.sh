@@ -52,7 +52,24 @@ if ! JAX_PLATFORMS=cpu timeout 2100 python -m dss_ml_at_scale_tpu.config.cli \
   echo "$(date -u +%H:%M:%S) preflight FAILED: dsst bench tier1 regressed - watchdog refusing to arm" >> tpu_watchdog.log
   exit 1
 fi
-echo "$(date -u +%H:%M:%S) preflight clean: lint + audit + sanitize + bench" >> tpu_watchdog.log
+# Live-SLO gate: rerun the serving scenario with a JSON artifact and
+# judge its embedded /slo snapshot (the stub server's burn-rate state).
+# --strict: the bench's ~5s of load cannot outlast the 10s
+# pending->firing debounce, so a burning objective appears as
+# "pending" — the state this gate refuses on.
+if ! JAX_PLATFORMS=cpu timeout 600 python -m dss_ml_at_scale_tpu.config.cli \
+    bench --scenarios serving --json > /tmp/dsst_watchdog_serving_slo.json \
+    2>> tpu_watchdog.log; then
+  echo "$(date -u +%H:%M:%S) preflight FAILED: serving bench for slo check - watchdog refusing to arm" >> tpu_watchdog.log
+  exit 1
+fi
+if ! JAX_PLATFORMS=cpu timeout 120 python -m dss_ml_at_scale_tpu.config.cli \
+    slo check --strict --report /tmp/dsst_watchdog_serving_slo.json \
+    >> tpu_watchdog.log 2>&1; then
+  echo "$(date -u +%H:%M:%S) preflight FAILED: dsst slo check found a burning objective - watchdog refusing to arm" >> tpu_watchdog.log
+  exit 1
+fi
+echo "$(date -u +%H:%M:%S) preflight clean: lint + audit + sanitize + bench + slo" >> tpu_watchdog.log
 N=0
 while true; do
   if [ "$(date -u +%s)" -ge "$DEADLINE_EPOCH" ]; then
