@@ -31,6 +31,7 @@ import threading
 import time
 from pathlib import Path
 
+from ..telemetry.tracecontext import Handoff
 from ..telemetry.windows import quantile
 
 
@@ -103,18 +104,26 @@ class _Client(threading.Thread):
         self.latencies: list[float] = []
         self.statuses: dict[int, int] = {}
         self.errors = 0
+        self.propagated = 0
 
     def run(self) -> None:
         conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
         self.barrier.wait()
         while not self.stop.is_set():
+            # The client mints the request's identity and injects it —
+            # the cross-process half of the Handoff contract. A server
+            # that adopts it echoes the SAME trace id back, so the
+            # propagated count below verifies end-to-end adoption.
+            handoff = Handoff.root("request")
             t0 = time.perf_counter()
             try:
                 conn.request("POST", "/predict", body=self.body,
-                             headers={"Content-Type": "image/jpeg"})
+                             headers={"Content-Type": "image/jpeg",
+                                      "X-DSST-Trace": handoff.to_header()})
                 resp = conn.getresponse()
                 resp.read()
                 status = resp.status
+                echoed = resp.getheader("X-DSST-Trace")
             except Exception:
                 self.errors += 1
                 conn.close()
@@ -124,6 +133,8 @@ class _Client(threading.Thread):
                 continue
             self.latencies.append(time.perf_counter() - t0)
             self.statuses[status] = self.statuses.get(status, 0) + 1
+            if echoed == handoff.ctx.trace_id:
+                self.propagated += 1
         conn.close()
 
 
@@ -169,6 +180,9 @@ def run_load(host: str, port: int, body: bytes, *, threads: int,
         "ok_rps": round(ok / wall, 2),
         "statuses": statuses,
         "transport_errors": sum(c.errors for c in clients),
+        # Requests whose injected trace id came back in X-DSST-Trace —
+        # equal to `requests` against a propagation-aware server.
+        "trace_propagated": sum(c.propagated for c in clients),
         "latency_s": {
             "p50": pct(0.50),
             "p90": pct(0.90),
@@ -213,23 +227,34 @@ class _StubScorer:
 
 def spawn_stub_server(*, micro_batch: int = 8, score_ms: float = 5.0,
                       batch_window_ms: float = 5.0, queue_depth: int = 64,
-                      deadline_ms: float = 0.0):
+                      deadline_ms: float = 0.0, access_log=None,
+                      flightrec=None):
     """Spawn the stub-scorer server subprocess; returns ``(proc, port)``
-    with ``/healthz`` already answering. Callers terminate ``proc``."""
+    with ``/healthz`` already answering. Callers terminate ``proc``.
+
+    ``access_log``/``flightrec`` (paths) arm the stub's structured
+    request log and flight-recorder tail — what the fleet tests use to
+    compare merged sketches against per-replica journaled ground truth
+    and to merge per-replica recorder files into one timeline."""
     import subprocess
 
+    argv = [sys.executable, "-m", "dss_ml_at_scale_tpu.bench.loadgen",
+            "--stub-serve",
+            "--micro-batch", str(micro_batch),
+            "--score-ms", str(score_ms),
+            "--batch-window-ms", str(batch_window_ms),
+            "--queue-depth", str(queue_depth),
+            "--deadline-ms", str(deadline_ms)]
+    if access_log is not None:
+        argv += ["--access-log", str(access_log)]
+    if flightrec is not None:
+        argv += ["--flightrec", str(flightrec)]
     # stdin is the parent-death channel: if the spawning process is
     # SIGKILLed (a bench watchdog kill can't run teardown), the kernel
     # closes the pipe and the stub's watcher thread sees EOF — no
     # orphaned server accumulating on the host per killed child.
     proc = subprocess.Popen(
-        [sys.executable, "-m", "dss_ml_at_scale_tpu.bench.loadgen",
-         "--stub-serve",
-         "--micro-batch", str(micro_batch),
-         "--score-ms", str(score_ms),
-         "--batch-window-ms", str(batch_window_ms),
-         "--queue-depth", str(queue_depth),
-         "--deadline-ms", str(deadline_ms)],
+        argv,
         stdout=subprocess.PIPE, stdin=subprocess.PIPE, text=True,
     )
     try:
@@ -248,8 +273,13 @@ def _stub_serve(args) -> int:
     import signal
 
     from ..serving import SchedulerConfig
+    from ..telemetry import flightrec
     from ..workloads.serving import serve_in_thread
 
+    if args.flightrec:
+        # Arm the flight-recorder tail BEFORE the server threads start,
+        # so every serving span of this replica reaches the file.
+        flightrec.enable(args.flightrec)
     handle = serve_in_thread(
         _StubScorer(args.micro_batch, args.score_ms),
         config=SchedulerConfig(
@@ -257,6 +287,7 @@ def _stub_serve(args) -> int:
             batch_window_ms=args.batch_window_ms,
             deadline_ms=args.deadline_ms,
         ),
+        access_log=args.access_log or None,
     )
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
@@ -306,6 +337,10 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-window-ms", type=float, default=5.0)
     ap.add_argument("--queue-depth", type=int, default=64)
     ap.add_argument("--deadline-ms", type=float, default=0.0)
+    ap.add_argument("--access-log", default=None,
+                    help="(stub-serve) structured request log path")
+    ap.add_argument("--flightrec", default=None,
+                    help="(stub-serve) flight-recorder tail path")
     ap.add_argument("--out", default=None, help="write the report JSON here")
     args = ap.parse_args(argv)
 

@@ -2778,6 +2778,13 @@ def register_trace(sub: argparse._SubParsersAction) -> None:
         "across threads; loads in ui.perfetto.dev",
     )
     _add_source(ex)
+    ex.add_argument(
+        "--merge", nargs="+", default=None, metavar="JSONL",
+        help="merge N replicas' recorder files into ONE timeline: each "
+        "file gets its own pid band + process lane, and propagated "
+        "trace ids draw flow arrows ACROSS files (overrides "
+        "--run/--file)",
+    )
     ex.add_argument("--out", required=True, metavar="OUT",
                     help="output trace file")
     ex.set_defaults(fn=_cmd_trace_export)
@@ -2866,18 +2873,32 @@ def _cmd_trace_tail(args: argparse.Namespace) -> int:
 
 
 def _cmd_trace_export(args: argparse.Namespace) -> int:
-    from ..telemetry.spans import load_span_jsonl, to_perfetto
+    from ..telemetry.spans import (
+        load_span_jsonl,
+        merge_replica_spans,
+        to_perfetto,
+    )
 
-    path = _trace_source(args)
-    if path is None:
-        return 2
-    events = load_span_jsonl(path)
+    process_names = None
+    if getattr(args, "merge", None):
+        missing = [p for p in args.merge if not Path(p).exists()]
+        if missing:
+            print(f"no trace file at {missing[0]}")
+            return 2
+        events, process_names = merge_replica_spans(args.merge)
+        src = f"{len(args.merge)} file(s)"
+    else:
+        path = _trace_source(args)
+        if path is None:
+            return 2
+        events = load_span_jsonl(path)
+        src = str(path)
     if not events:
-        print(f"no parseable events in {path}")
+        print(f"no parseable events in {src}")
         return 1
     # Build in memory, count from the dict, write once — re-reading the
     # file just written (possibly tens of MB) to count flows is waste.
-    trace = to_perfetto(events)
+    trace = to_perfetto(events, process_names=process_names)
     flows = sum(
         1 for e in trace["traceEvents"] if e.get("ph") in ("s", "f")
     )
@@ -3210,6 +3231,7 @@ def register_slo(sub: argparse._SubParsersAction) -> None:
         "value, budget remaining, burn rates, and alert state",
     )
     _add_slo_source_args(st)
+    _add_fleet_args(st)
     st.add_argument("--json", action="store_true",
                     help="print the raw /slo document (schema v1)")
     st.set_defaults(fn=_cmd_slo_status)
@@ -3219,6 +3241,7 @@ def register_slo(sub: argparse._SubParsersAction) -> None:
         "claim can't ship while an SLO burns)",
     )
     _add_slo_source_args(ck)
+    _add_fleet_args(ck)
     ck.add_argument("--json", action="store_true")
     ck.add_argument(
         "--strict", action="store_true",
@@ -3229,6 +3252,7 @@ def register_slo(sub: argparse._SubParsersAction) -> None:
         "watch", help="poll /slo and redraw the status frame",
     )
     _add_slo_source_args(wa)
+    _add_fleet_args(wa)
     wa.add_argument("--interval", type=float, default=2.0,
                     metavar="SECONDS")
     wa.add_argument(
@@ -3346,7 +3370,154 @@ def _slo_render_text(doc: dict) -> list[str]:
     return lines
 
 
+# -- fleet mode (slo --fleet / top --fleet) ---------------------------------
+
+
+def _add_fleet_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--fleet", nargs="+", default=None, metavar="ENDPOINT",
+        help="fleet mode: scrape N replicas' /telemetry endpoints "
+        "(host:port ...), merge their registries and SLO windows, and "
+        "judge the FLEET instead of one process",
+    )
+    p.add_argument(
+        "--fleet-timeout", type=float, default=2.0, metavar="SECONDS",
+        help="per-cycle scrape budget; a replica that doesn't answer "
+        "inside it costs its column, never the cycle",
+    )
+    p.add_argument(
+        "--fleet-journal", default=None, metavar="JSONL",
+        help="journal each fleet scrape cycle crash-durably to this "
+        "path (outcome per replica, merged firing set)",
+    )
+
+
+def _fleet_aggregator(args: argparse.Namespace):
+    from ..telemetry import federation
+
+    return federation.FleetAggregator(
+        args.fleet,
+        timeout_s=args.fleet_timeout,
+        journal_path=args.fleet_journal,
+    )
+
+
+def _fleet_replica_rows(view) -> list[str]:
+    """Per-replica columns: liveness, that replica's OWN live p99 +
+    request count (off its raw window wire), staleness, scrape cost."""
+    from ..telemetry import windows as _windows
+
+    rows = []
+    for r in view.replicas:
+        p99 = reqs = None
+        if r.doc is not None:
+            for m in r.doc.get("metrics", ()):
+                if m.get("name") == "serving_request_window_seconds":
+                    wire = m.get("wire") or {}
+                    try:
+                        p99 = _windows.quantile_of_wire(wire, 0.99)
+                        reqs = int(wire.get("count", 0))
+                    except (ValueError, TypeError, KeyError):
+                        pass
+                    break
+        rows.append((
+            r.endpoint,
+            r.outcome,
+            "-" if p99 is None else f"{p99 * 1000:.1f}ms",
+            "-" if reqs is None else str(reqs),
+            ("-" if r.staleness_s is None
+             else f"{r.staleness_s:.0f}s"),
+            f"{r.elapsed_s * 1000:.0f}ms",
+        ))
+    header = ("REPLICA", "STATE", "p99", "REQS", "STALE", "SCRAPE")
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows
+        else len(header[i])
+        for i in range(len(header))
+    ]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths))]
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return lines
+
+
+def _fleet_window_rows(view) -> list[str]:
+    """The MERGED windowed quantile series — the fleet-wide sibling of
+    `dsst top`'s per-process windows section."""
+    rows = []
+    for fam in view.registry.families():
+        if fam.kind != "window":
+            continue
+        for labels, sample in fam._series():
+            label_txt = ",".join(
+                f"{k}={v}" for k, v in sorted(labels.items())
+            )
+            name = fam.name + (f"{{{label_txt}}}" if label_txt else "")
+            cells = " ".join(
+                f"p{float(q) * 100:g}="
+                + ("-" if v is None else f"{v * 1000:.2f}ms")
+                for q, v in sorted(sample.get("quantiles", {}).items())
+            )
+            rows.append(
+                f"  {name:<44} {cells}  n={sample.get('count', 0)}"
+            )
+    return rows
+
+
+def _fleet_doc(view) -> dict:
+    """The fleet status document (--json shape): per-replica outcomes
+    plus the merged SLO judgment."""
+    return {
+        "version": 1,
+        "ts": round(view.ts, 3),
+        "up": view.up,
+        "replicas": [
+            {
+                "endpoint": r.endpoint,
+                "up": r.up,
+                "outcome": r.outcome,
+                "elapsed_ms": round(r.elapsed_s * 1000, 1),
+                "staleness_s": (
+                    round(r.staleness_s, 1)
+                    if r.staleness_s is not None else None
+                ),
+                **({"error": r.error} if r.error else {}),
+            }
+            for r in view.replicas
+        ],
+        "merged_series": view.merged_series,
+        "slo": view.slo,
+    }
+
+
+def _fleet_frame(agg, view, *, windows: bool = False) -> list[str]:
+    lines = [
+        f"dsst fleet — {len(agg.endpoints)} endpoint(s), "
+        f"{view.up} up  {time.strftime('%H:%M:%S')}",
+        "",
+    ]
+    lines.extend(_fleet_replica_rows(view))
+    lines.append("")
+    lines.extend(_slo_render_text(view.slo))
+    if windows:
+        rows = _fleet_window_rows(view)
+        if rows:
+            lines.append("")
+            lines.append("fleet windows (merged):")
+            lines.extend(rows)
+    return lines
+
+
 def _cmd_slo_status(args: argparse.Namespace) -> int:
+    if args.fleet:
+        agg = _fleet_aggregator(args)
+        view = agg.scrape()
+        if args.json:
+            print(json.dumps(_fleet_doc(view), indent=1))
+        else:
+            for line in _fleet_frame(agg, view):
+                print(line)
+        return 0
     doc = _slo_fetch_status(args)
     if doc is None:
         return 2
@@ -3359,6 +3530,37 @@ def _cmd_slo_status(args: argparse.Namespace) -> int:
 
 
 def _cmd_slo_check(args: argparse.Namespace) -> int:
+    if args.fleet:
+        from ..telemetry import federation
+
+        agg = _fleet_aggregator(args)
+        view = agg.scrape()
+        if view.up == 0:
+            print("dsst slo: no replica answered the fleet scrape",
+                  file=sys.stderr)
+            return 2
+        # One-shot judgment: a fresh state machine has had no cycles
+        # to debounce pending→firing, so "burning" is the raw
+        # two-window condition (federation.burning) — plus anything
+        # already firing in the merged judgment.
+        bad = federation.burning(view.slo)
+        if args.strict:
+            bad = sorted(set(bad) | {
+                o["name"] for o in view.slo.get("objectives", [])
+                if o.get("state") == "pending"
+            })
+        if args.json:
+            print(json.dumps({
+                **_fleet_doc(view),
+                "ok": not bad,
+                "failing": bad,
+            }, indent=1))
+        else:
+            for line in _fleet_frame(agg, view):
+                print(line)
+            print("fleet slo check: "
+                  + ("OK" if not bad else "FAILING " + ", ".join(bad)))
+        return 1 if bad else 0
     doc = _slo_fetch_status(args)
     if doc is None:
         return 2
@@ -3385,16 +3587,25 @@ def _cmd_slo_check(args: argparse.Namespace) -> int:
 
 def _cmd_slo_watch(args: argparse.Namespace) -> int:
     frames = 0
+    # ONE aggregator across frames: the fleet alert state machine and
+    # staleness clocks must persist or pending can never reach firing.
+    agg = _fleet_aggregator(args) if args.fleet else None
     try:
         while True:
-            doc = _slo_fetch_status(args)
-            if doc is None:
-                return 2
-            print("\x1b[2J\x1b[H", end="")
-            print(f"dsst slo watch — {args.report or args.url}  "
-                  f"{time.strftime('%H:%M:%S')}")
-            for line in _slo_render_text(doc):
-                print(line)
+            if agg is not None:
+                view = agg.scrape()
+                print("\x1b[2J\x1b[H", end="")
+                for line in _fleet_frame(agg, view):
+                    print(line)
+            else:
+                doc = _slo_fetch_status(args)
+                if doc is None:
+                    return 2
+                print("\x1b[2J\x1b[H", end="")
+                print(f"dsst slo watch — {args.report or args.url}  "
+                      f"{time.strftime('%H:%M:%S')}")
+                for line in _slo_render_text(doc):
+                    print(line)
             frames += 1
             if args.iterations and frames >= args.iterations:
                 return 0
@@ -3414,6 +3625,7 @@ def register_top(sub: argparse._SubParsersAction) -> None:
         "--url", default="http://127.0.0.1:8008", metavar="URL",
         help="the dsst serve process to watch",
     )
+    _add_fleet_args(tp)
     tp.add_argument("--interval", type=float, default=2.0,
                     metavar="SECONDS")
     tp.add_argument(
@@ -3532,14 +3744,22 @@ def _top_frame(url: str) -> list[str]:
 
 
 def _cmd_top(args: argparse.Namespace) -> int:
+    # Fleet mode holds ONE aggregator across frames (persistent alert
+    # state machine + staleness clocks), and its frame adds the merged
+    # fleet windows under the per-replica columns.
+    agg = _fleet_aggregator(args) if args.fleet else None
     try:
         while True:
-            try:
-                frame = _top_frame(args.url)
-            except (OSError, ValueError) as e:
-                print(f"dsst top: cannot scrape {args.url}: {e}",
-                      file=sys.stderr)
-                return 2
+            if agg is not None:
+                view = agg.scrape()
+                frame = _fleet_frame(agg, view, windows=True)
+            else:
+                try:
+                    frame = _top_frame(args.url)
+                except (OSError, ValueError) as e:
+                    print(f"dsst top: cannot scrape {args.url}: {e}",
+                          file=sys.stderr)
+                    return 2
             if not args.once:
                 print("\x1b[2J\x1b[H", end="")
             for line in frame:

@@ -28,7 +28,7 @@ from .registry import (
     log_buckets,
 )
 from .spans import SpanLog, export_perfetto, to_perfetto
-from . import flightrec, slo, tracecontext, windows
+from . import federation, flightrec, slo, tracecontext, windows
 from .tracecontext import Handoff, TraceContext
 from .windows import SlidingQuantile, WindowedCounter, quantile
 
@@ -48,6 +48,7 @@ __all__ = [
     "counter",
     "device_memory_stats",
     "export_perfetto",
+    "federation",
     "flightrec",
     "gauge",
     "get_registry",

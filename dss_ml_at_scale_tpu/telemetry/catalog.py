@@ -81,6 +81,9 @@ KNOWN_METRICS: dict[str, str] = {
     "admission_est_queue_wait_ms": "gauge",
     "admission_service_rate_ewma": "gauge",
     "feeder_stall_window_seconds": "window",
+    "fleet_replicas_up": "gauge",
+    "fleet_scrape_staleness_seconds": "gauge",
+    "fleet_scrape_total": "counter",
     "serving_request_window_seconds": "window",
     "slo_alert_transitions_total": "counter",
     "slo_alerts_firing": "gauge",

@@ -83,6 +83,15 @@ class _CounterValue:
         # dsst: ignore[lock-discipline,guarded-by] lock-free approximate read: render paths tolerate a torn float; never written here
         return {"value": self.value}
 
+    def _wire(self) -> dict:
+        # dsst: ignore[lock-discipline,guarded-by] lock-free approximate read, same contract as _sample
+        v = self.value
+        return {"v": _windows.WIRE_VERSION, "kind": "counter", "value": v}
+
+    def _merge_wire(self, wire: dict) -> None:
+        _check_value_wire(wire, "counter")
+        self.inc(float(wire["value"]))
+
 
 class _GaugeValue:
     """One gauge series."""
@@ -113,6 +122,18 @@ class _GaugeValue:
     def _sample(self) -> dict:
         # dsst: ignore[lock-discipline,guarded-by] lock-free approximate read: render paths tolerate a torn float; never written here
         return {"value": self.value}
+
+    def _wire(self) -> dict:
+        # dsst: ignore[lock-discipline,guarded-by] lock-free approximate read, same contract as _sample
+        v = self.value
+        return {"v": _windows.WIRE_VERSION, "kind": "gauge", "value": v}
+
+    def _merge_wire(self, wire: dict) -> None:
+        # Fleet semantics for gauges are ADDITIVE (queue depths, ready
+        # replicas, firing alerts all sum meaningfully across a fleet);
+        # per-replica values stay visible in the unmerged snapshots.
+        _check_value_wire(wire, "gauge")
+        self.inc(float(wire["value"]))
 
 
 class _HistogramValue:
@@ -155,6 +176,36 @@ class _HistogramValue:
         out.append(["+Inf", total])
         return {"count": total, "sum": s, "buckets": out}
 
+    def _wire(self) -> dict:
+        """RAW per-bucket counts (not the cumulative render): what a
+        peer can add bucket-wise without reconstructing deltas."""
+        with self._lock:
+            return {"v": _windows.WIRE_VERSION, "kind": "histogram",
+                    "buckets": list(self.buckets),
+                    "counts": list(self.counts),
+                    "sum": self.sum, "count": self.count}
+
+    def _merge_wire(self, wire: dict) -> None:
+        _check_value_wire(wire, "histogram")
+        if tuple(float(b) for b in wire.get("buckets", ())) != self.buckets:
+            raise ValueError(
+                "histogram wire bucket mismatch: this series has "
+                f"{len(self.buckets)} buckets, wire carries "
+                f"{len(wire.get('buckets', ()))}"
+            )
+        counts = wire.get("counts")
+        if not isinstance(counts, list) or \
+                len(counts) != len(self.buckets) + 1:
+            raise ValueError(
+                f"histogram wire counts mismatch: expected "
+                f"{len(self.buckets) + 1} entries"
+            )
+        with self._lock:
+            for i, c in enumerate(counts):
+                self.counts[i] += int(c)
+            self.sum += float(wire["sum"])
+            self.count += int(wire["count"])
+
 
 class _WindowValue:
     """One windowed series: a sliding-window quantile sketch.
@@ -185,6 +236,33 @@ class _WindowValue:
 
     def _sample(self) -> dict:
         return self._sketch.snapshot(self._quantiles)
+
+    def _wire(self) -> dict:
+        # The sketch's own wire payload (kind "sliding_quantile") plus
+        # the family's quantile list, so a federating receiver can
+        # re-register the family with identical geometry.
+        return {**self._sketch.to_wire(),
+                "quantiles": list(self._quantiles)}
+
+    def _merge_wire(self, wire: dict) -> None:
+        self._sketch.merge_wire(wire)
+
+
+def _check_value_wire(wire, kind: str) -> None:
+    """Version + kind gate for the scalar/histogram wire payloads (the
+    window kind delegates to the sketch's own check)."""
+    if not isinstance(wire, dict):
+        raise ValueError(f"wire payload must be a dict, got {type(wire)}")
+    if wire.get("v") != _windows.WIRE_VERSION:
+        raise ValueError(
+            f"wire version mismatch: expected {_windows.WIRE_VERSION}, "
+            f"got {wire.get('v')!r}"
+        )
+    if wire.get("kind") != kind:
+        raise ValueError(
+            f"wire kind mismatch: expected {kind!r}, "
+            f"got {wire.get('kind')!r}"
+        )
 
 
 _CHILD_TYPES = {
@@ -325,6 +403,16 @@ class MetricFamily:
             for key, child in items
         ]
 
+    def _wire_series(self) -> list[tuple[dict, dict]]:
+        """[(labels_dict, wire_dict), ...] — the mergeable sibling of
+        :meth:`_series`, feeding :meth:`MetricsRegistry.wire_snapshot`."""
+        with self._lock:
+            items = sorted(self._children.items())
+        return [
+            (dict(zip(self.label_names, key)), child._wire())
+            for key, child in items
+        ]
+
 
 class MetricsRegistry:
     """Get-or-create registry of metric families, one per process."""
@@ -408,6 +496,70 @@ class MetricsRegistry:
         """Zero every series; registrations (and label children) remain."""
         for fam in self.families():
             fam._reset()
+
+    # -- federation wire form ---------------------------------------------
+
+    def wire_snapshot(self) -> dict:
+        """Mergeable snapshot of every series — what ``GET /telemetry``
+        serves. Unlike :meth:`snapshot` (render-oriented: cumulative
+        histogram pairs, resolved quantiles) this carries the RAW
+        internals (per-bucket counts, window digest counts) so a peer
+        registry can fold them in with :meth:`merge_wire_snapshot`.
+        """
+        metrics = []
+        for fam in self.families():
+            for labels, wire in fam._wire_series():
+                metrics.append({
+                    "name": fam.name,
+                    "type": fam.kind,
+                    "help": fam.help,
+                    "labels": labels,
+                    "wire": wire,
+                })
+        return {
+            "version": _windows.WIRE_VERSION,
+            "ts": time.time(),
+            "metrics": metrics,
+        }
+
+    def merge_wire_snapshot(self, snap: dict) -> int:
+        """Fold a peer's :meth:`wire_snapshot` into this registry.
+
+        Families are get-or-create with the wire's geometry, so a
+        mismatch against an existing local family fails loudly through
+        :meth:`_get` (kind / labels / buckets / window geometry), just
+        like two local call sites disagreeing. Returns the number of
+        series merged.
+        """
+        if not isinstance(snap, dict):
+            raise ValueError(f"snapshot must be a dict, got {type(snap)}")
+        if snap.get("version") != _windows.WIRE_VERSION:
+            raise ValueError(
+                f"snapshot version mismatch: expected "
+                f"{_windows.WIRE_VERSION}, got {snap.get('version')!r}"
+            )
+        merged = 0
+        for entry in snap.get("metrics", ()):
+            name = entry["name"]
+            kind = entry["type"]
+            labels = dict(entry.get("labels") or {})
+            wire = entry["wire"]
+            buckets = None
+            window_s = None
+            quantiles = None
+            if kind == "histogram":
+                buckets = tuple(float(b) for b in wire["buckets"])
+            elif kind == "window":
+                window_s = float(wire["window_s"])
+                qs = wire.get("quantiles")
+                quantiles = tuple(float(q) for q in qs) if qs else None
+            fam = self._get(
+                kind, name, entry.get("help", ""), tuple(labels),
+                buckets, window_s=window_s, quantiles=quantiles,
+            )
+            fam.labels(**labels)._merge_wire(wire)
+            merged += 1
+        return merged
 
     # -- renderers --------------------------------------------------------
 

@@ -245,6 +245,28 @@ class _EventSource:
         n = int(good + bad)
         return ((bad / n) if n else None), n
 
+    def to_wire(self) -> dict:
+        return {
+            "kind": "events",
+            "good_f": self.good_f.to_wire(),
+            "bad_f": self.bad_f.to_wire(),
+            "good_s": self.good_s.to_wire(),
+            "bad_s": self.bad_s.to_wire(),
+            "sketch": self.sketch.to_wire(),
+        }
+
+    def merge_wire(self, wire: dict) -> None:
+        if not isinstance(wire, dict) or wire.get("kind") != "events":
+            raise ValueError(
+                f"SLO source wire kind mismatch: expected 'events', "
+                f"got {wire.get('kind') if isinstance(wire, dict) else wire!r}"
+            )
+        self.good_f.merge_wire(wire["good_f"])
+        self.bad_f.merge_wire(wire["bad_f"])
+        self.good_s.merge_wire(wire["good_s"])
+        self.bad_s.merge_wire(wire["bad_s"])
+        self.sketch.merge_wire(wire["sketch"])
+
 
 class _FractionSource:
     """A windowed seconds-per-second fraction (stall time)."""
@@ -271,6 +293,22 @@ class _FractionSource:
         w = self.f if fast else self.s
         return w.total() / w.window_s
 
+    def to_wire(self) -> dict:
+        return {
+            "kind": "fraction",
+            "f": self.f.to_wire(),
+            "s": self.s.to_wire(),
+        }
+
+    def merge_wire(self, wire: dict) -> None:
+        if not isinstance(wire, dict) or wire.get("kind") != "fraction":
+            raise ValueError(
+                f"SLO source wire kind mismatch: expected 'fraction', "
+                f"got {wire.get('kind') if isinstance(wire, dict) else wire!r}"
+            )
+        self.f.merge_wire(wire["f"])
+        self.s.merge_wire(wire["s"])
+
 
 class _QuantileSource:
     """Fast+slow sketches of one measured duration."""
@@ -284,6 +322,22 @@ class _QuantileSource:
     def note(self, seconds: float, trace: str | None = None) -> None:
         self.f.observe(seconds, trace=trace)
         self.s.observe(seconds, trace=trace)
+
+    def to_wire(self) -> dict:
+        return {
+            "kind": "quantile",
+            "f": self.f.to_wire(),
+            "s": self.s.to_wire(),
+        }
+
+    def merge_wire(self, wire: dict) -> None:
+        if not isinstance(wire, dict) or wire.get("kind") != "quantile":
+            raise ValueError(
+                f"SLO source wire kind mismatch: expected 'quantile', "
+                f"got {wire.get('kind') if isinstance(wire, dict) else wire!r}"
+            )
+        self.f.merge_wire(wire["f"])
+        self.s.merge_wire(wire["s"])
 
 
 class SloEngine:
@@ -410,6 +464,68 @@ class SloEngine:
             self._targets = {}
             self._latency_budget_s = DEFAULT_LATENCY_BUDGET_S
             self._last_eval = 0.0
+
+    def reset_sources(self) -> None:
+        """Clear the measurement windows only, KEEPING alert states,
+        targets, budget, and journal — what a fleet aggregator does
+        between scrape cycles: each cycle re-merges fresh per-replica
+        windows, but the fleet alert state machine must persist across
+        cycles or nothing ever debounces from pending to firing."""
+        with self._lock:
+            objs = self._objectives
+            for o in objs.values():
+                if o.kind == "events":
+                    self._sources[o.name] = _EventSource(o, self._clock)
+                elif o.kind == "fraction":
+                    self._sources[o.name] = _FractionSource(o, self._clock)
+                else:
+                    self._sources[o.name] = _QuantileSource(o, self._clock)
+
+    # -- federation wire form ----------------------------------------------
+
+    def wire_sources(self) -> dict:
+        """The engine's measurement state as a mergeable wire document
+        (the ``slo_sources`` half of ``GET /telemetry``): every
+        objective's raw windows/sketches plus the latency budget a
+        receiver needs to judge seconds-unit objectives."""
+        return {
+            "version": SLO_SCHEMA_VERSION,
+            "latency_budget_s": self.latency_budget,
+            "sources": {
+                name: src.to_wire()
+                for name, src in self._sources.items()
+            },
+        }
+
+    def merge_wire_sources(self, doc: dict) -> int:
+        """Fold a peer engine's :meth:`wire_sources` into this one's
+        windows. Unknown objective names are skipped (a newer replica
+        may declare objectives this aggregator doesn't know); geometry
+        or kind mismatches on known names raise loudly. Returns the
+        number of sources merged."""
+        if not isinstance(doc, dict):
+            raise ValueError(f"slo_sources must be a dict, got {type(doc)}")
+        if doc.get("version") != SLO_SCHEMA_VERSION:
+            raise ValueError(
+                f"slo_sources version mismatch: expected "
+                f"{SLO_SCHEMA_VERSION}, got {doc.get('version')!r}"
+            )
+        merged = 0
+        for name, wire in (doc.get("sources") or {}).items():
+            src = self._sources.get(name)
+            if src is None:
+                continue
+            src.merge_wire(wire)
+            merged += 1
+        # Adopt the strictest (smallest) armed latency budget seen
+        # across the fleet, so a fleet judgment is never laxer than
+        # the tightest replica's own.
+        budget = doc.get("latency_budget_s")
+        if isinstance(budget, (int, float)) and budget > 0:
+            with self._lock:
+                if budget < self._latency_budget_s:
+                    self._latency_budget_s = float(budget)
+        return merged
 
     # -- sources -----------------------------------------------------------
 

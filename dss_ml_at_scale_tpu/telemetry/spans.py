@@ -41,7 +41,7 @@ import os
 import threading
 import time
 from pathlib import Path
-from typing import Iterable, Iterator
+from typing import Iterable, Iterator, Mapping, Sequence
 
 from ..utils.jsonl import JsonlWriter
 from ..utils.profiling import annotate
@@ -210,6 +210,10 @@ def _flow_events(spans: list[dict]) -> list[dict]:
     threads get one arrow: an ``s`` anchored inside the source span and
     an ``f`` (``bp: "e"`` — bind to enclosing slice) inside the target.
     Same-thread succession needs no arrow; nesting already shows it.
+    Succession is judged on the ``(pid, tid)`` PAIR: in a merged
+    multi-replica timeline two processes legitimately reuse the same
+    tid integer, and comparing tids alone would silently drop exactly
+    the cross-process arrows the merge exists to draw.
     """
     by_trace: dict[str, list[dict]] = {}
     for e in spans:
@@ -220,7 +224,7 @@ def _flow_events(spans: list[dict]) -> list[dict]:
         group.sort(key=lambda e: float(e.get("ts", 0.0)))
         hop = 0
         for a, b in zip(group, group[1:]):
-            if a.get("tid") == b.get("tid"):
+            if (a.get("pid"), a.get("tid")) == (b.get("pid"), b.get("tid")):
                 continue
             flow_id = int(trace_id[:8], 16) * 64 + (hop % 64)
             hop += 1
@@ -242,7 +246,8 @@ def _flow_events(spans: list[dict]) -> list[dict]:
     return flows
 
 
-def to_perfetto(events: Iterable[dict]) -> dict:
+def to_perfetto(events: Iterable[dict],
+                process_names: Mapping[int, str] | None = None) -> dict:
     """Span dicts → Chrome ``trace_event`` JSON object.
 
     Emits ``ph: "M"`` process/thread-name metadata (lanes labeled with
@@ -251,6 +256,10 @@ def to_perfetto(events: Iterable[dict]) -> dict:
     ``ts``/``dur`` sorted by ``ts``, and ``ph: "s"/"f"`` flow arrows
     connecting spans that share a trace id across threads. The result is
     ``json.dump``-able as-is.
+
+    ``process_names`` maps pid → display name for multi-process
+    timelines (:func:`merge_replica_spans` labels each replica's lane);
+    unmapped pids keep the default ``"dsst"``.
     """
     spans = sorted(events, key=lambda e: float(e.get("ts", 0.0)))
     trace_events: list[dict] = []
@@ -267,7 +276,8 @@ def to_perfetto(events: Iterable[dict]) -> dict:
     for pid in sorted(pids):
         trace_events.append({
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-            "ts": 0, "args": {"name": "dsst"},
+            "ts": 0,
+            "args": {"name": (process_names or {}).get(pid, "dsst")},
         })
     for (pid, tid), name in sorted(thread_names.items()):
         trace_events.append({
@@ -320,6 +330,43 @@ def load_span_jsonl(path: str | os.PathLike) -> list[dict]:
             for o in opens
         ]
     return events
+
+
+# Pid stride between merged replicas — the `bench profile` pid-offset
+# idiom (PROFILER_PID_OFFSET there): far above any real OS pid, so a
+# remapped lane can never collide with another replica's.
+REPLICA_PID_STRIDE = 1 << 20
+
+
+def merge_replica_spans(
+    paths: Sequence[str | os.PathLike],
+) -> tuple[list[dict], dict[int, str]]:
+    """Merge N replicas' span/flight-recorder files into ONE timeline.
+
+    Each file's pids are densely remapped into a per-replica band
+    (``i * REPLICA_PID_STRIDE + j``), so two replicas that ran as the
+    same OS pid (containers, or plain restarts) land in distinct
+    Perfetto process lanes. Returns ``(events, process_names)`` ready
+    for :func:`to_perfetto` — which draws flow arrows *across files*
+    for propagated trace ids, because ``_flow_events`` keys on the
+    trace id and judges hops on the (pid, tid) pair.
+    """
+    merged: list[dict] = []
+    process_names: dict[int, str] = {}
+    for i, path in enumerate(paths):
+        events = load_span_jsonl(path)
+        remap: dict[int, int] = {}
+        for e in events:
+            orig = int(e.get("pid", 0))
+            pid = remap.get(orig)
+            if pid is None:
+                pid = i * REPLICA_PID_STRIDE + len(remap)
+                remap[orig] = pid
+                process_names[pid] = (
+                    f"replica {i}: {Path(path).name} (pid {orig})"
+                )
+            merged.append({**e, "pid": pid})
+    return merged, process_names
 
 
 def export_perfetto(jsonl_path: str | os.PathLike,

@@ -39,6 +39,7 @@ import contextlib
 import contextvars
 import dataclasses
 import os
+import re
 from typing import Iterator
 
 # The one propagation channel. Deliberately module-private: readers use
@@ -46,6 +47,21 @@ from typing import Iterator
 # matching reset and a leaked context cannot outlive its scope.
 _ctx: contextvars.ContextVar["TraceContext | None"] = contextvars.ContextVar(
     "dsst_trace_ctx", default=None
+)
+
+
+# Wire form of a Handoff (W3C-traceparent-shaped, dsst field widths):
+#   dsst1-<trace_id:16 hex>-<span_id:8 hex>-<kind>
+# The version prefix is bumped if the field layout ever changes, so a
+# mixed-version fleet degrades to minting (from_header -> None) instead
+# of misparsing. Parsing is deliberately paranoid: the header arrives
+# from the network, so anything but an exact match mints a fresh trace.
+TRACE_HEADER_PREFIX = "dsst1"
+# Hard cap well above the ~48 chars a valid header needs: an oversized
+# value is rejected before the regex ever runs.
+_HEADER_MAX_LEN = 64
+_HEADER_RE = re.compile(
+    r"\Adsst1-([0-9a-f]{16})-([0-9a-f]{8})-([a-z][a-z0-9_]{0,15})\Z"
 )
 
 
@@ -146,3 +162,34 @@ class Handoff:
             yield self.ctx
         finally:
             _ctx.reset(token)
+
+    # -- wire codec (cross-PROCESS handoff) -------------------------------
+
+    def to_header(self) -> str | None:
+        """This handoff as an ``X-DSST-Trace`` request-header value
+        (``dsst1-<trace>-<span>-<kind>``), or None for an empty handoff
+        — the cross-process half of the thread-handoff contract: a
+        client injects it, the serving edge adopts it, and the hop
+        renders as ONE linked Perfetto flow instead of two orphan
+        traces."""
+        if self.ctx is None:
+            return None
+        return (
+            f"{TRACE_HEADER_PREFIX}-{self.ctx.trace_id}"
+            f"-{self.ctx.span_id}-{self.ctx.kind}"
+        )
+
+    @classmethod
+    def from_header(cls, value) -> "Handoff":
+        """Parse a wire header back into a Handoff. NEVER raises: the
+        value arrives from the network, so anything malformed (wrong
+        type, oversized, bad hex, wrong field count, unknown version)
+        yields ``Handoff(None)`` — the caller mints, exactly as for an
+        absent header."""
+        if not isinstance(value, str) or len(value) > _HEADER_MAX_LEN:
+            return cls(None)
+        m = _HEADER_RE.match(value)
+        if m is None:
+            return cls(None)
+        trace_id, span_id, kind = m.groups()
+        return cls(TraceContext(trace_id, span_id, kind))

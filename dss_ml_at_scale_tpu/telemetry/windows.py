@@ -58,6 +58,35 @@ SKETCH_HI = 100.0
 
 DEFAULT_QUANTILES = (0.5, 0.9, 0.99)
 
+# Wire-format version for to_wire()/merge_wire(): bumped if the payload
+# layout ever changes, so a mixed-version fleet fails its merges loudly
+# instead of silently misfolding digests.
+WIRE_VERSION = 1
+
+
+def _check_wire(wire, kind: str, window_s: float) -> None:
+    """Shared merge_wire validation: version, kind tag, and window
+    geometry must match EXACTLY — the histogram-bucket precedent
+    (:meth:`~.registry.MetricsRegistry._get` raises on mismatched
+    buckets rather than silently forking a series)."""
+    if not isinstance(wire, dict):
+        raise ValueError(f"wire payload must be a dict, got {type(wire)}")
+    v = wire.get("v")
+    if v != WIRE_VERSION:
+        raise ValueError(
+            f"wire version mismatch: expected {WIRE_VERSION}, got {v!r}"
+        )
+    if wire.get("kind") != kind:
+        raise ValueError(
+            f"wire kind mismatch: expected {kind!r}, "
+            f"got {wire.get('kind')!r}"
+        )
+    if float(wire.get("window_s", -1.0)) != window_s:
+        raise ValueError(
+            f"wire window geometry mismatch: this series has "
+            f"window_s={window_s}, wire carries {wire.get('window_s')!r}"
+        )
+
 
 def quantile(samples: Sequence[float], q: float) -> float:
     """Exact quantile of ``samples``: linear interpolation between
@@ -195,6 +224,25 @@ class WindowedCounter(_Windowed):
                 sum(self._ring.slots)
                 / self._ring.covered(now, self.window_s)
             )
+
+    def to_wire(self) -> dict:
+        """Versioned mergeable snapshot of the live window (the
+        ``/telemetry`` federation payload)."""
+        return {
+            "v": WIRE_VERSION,
+            "kind": "windowed_counter",
+            "window_s": self.window_s,
+            "total": self.total(),
+        }
+
+    def merge_wire(self, wire: dict) -> None:
+        """Fold a peer replica's :meth:`to_wire` payload into this
+        counter's CURRENT sub-window. Geometry/version mismatch raises
+        (the histogram-bucket precedent: fail loudly, never fork)."""
+        _check_wire(wire, "windowed_counter", self.window_s)
+        total = float(wire["total"])
+        if total:
+            self.add(total)
 
 
 class _Digest:
@@ -338,6 +386,68 @@ class SlidingQuantile(_Windowed):
             n = sum(d.count for d in self._ring.slots)
             return n / self._ring.covered(now, self.window_s)
 
+    def to_wire(self) -> dict:
+        """Versioned mergeable snapshot: the merged live digest plus
+        the sketch geometry a receiver needs to verify before folding
+        (edges + window). This is what ``GET /telemetry`` serves per
+        window series and what the fleet aggregator merges."""
+        d = self._merged()
+        return {
+            "v": WIRE_VERSION,
+            "kind": "sliding_quantile",
+            "window_s": self.window_s,
+            "edges": list(self.edges),
+            "counts": list(d.counts),
+            "count": d.count,
+            "sum": d.sum,
+            "min": d.mn if d.count else None,
+            "max": d.mx if d.count else None,
+            "worst_trace": d.worst_trace,
+        }
+
+    def merge_wire(self, wire: dict) -> None:
+        """Fold a peer replica's :meth:`to_wire` digest into the CURRENT
+        sub-window. Bucket edges and window length must match exactly —
+        merging counts across different edge vectors would silently
+        corrupt every quantile, so a mismatch raises (the
+        histogram-bucket precedent)."""
+        _check_wire(wire, "sliding_quantile", self.window_s)
+        edges = tuple(float(e) for e in wire.get("edges", ()))
+        if edges != self.edges:
+            raise ValueError(
+                "wire sketch geometry mismatch: this sketch has "
+                f"{len(self.edges)} edges "
+                f"[{self.edges[0]:g}..{self.edges[-1]:g}], wire carries "
+                f"{len(edges)} edge(s)"
+            )
+        counts = wire.get("counts")
+        if not isinstance(counts, list) or \
+                len(counts) != len(self.edges) + 1:
+            raise ValueError(
+                f"wire sketch counts mismatch: expected "
+                f"{len(self.edges) + 1} buckets, got "
+                f"{len(counts) if isinstance(counts, list) else counts!r}"
+            )
+        n = int(wire["count"])
+        if n <= 0:
+            return  # empty window: nothing to fold
+        mn, mx = float(wire["min"]), float(wire["max"])
+        now = self._clock()
+        with self._lock:
+            self._ring.advance(now, self._dt, self._new_slot)
+            d = self._ring.slots[self._ring.index]
+            for i, c in enumerate(counts):
+                d.counts[i] += int(c)
+            d.count += n
+            d.sum += float(wire["sum"])
+            if mn < d.mn:
+                d.mn = mn
+            if mx >= d.mx:
+                d.mx = mx
+                trace = wire.get("worst_trace")
+                if trace is not None:
+                    d.worst_trace = str(trace)
+
     def snapshot(self, qs: Sequence[float] = DEFAULT_QUANTILES) -> dict:
         """One JSON-ready windowed summary (the registry's ``window``
         sample shape)."""
@@ -357,3 +467,16 @@ class SlidingQuantile(_Windowed):
                 f"{q:g}": self._quantile_of(d, q) for q in qs
             },
         }
+
+
+def quantile_of_wire(wire: dict, q: float) -> float | None:
+    """Quantile straight off one :meth:`SlidingQuantile.to_wire`
+    payload (no merging): what renders a single replica's live p99
+    column in ``dsst top --fleet``. Validation rides the same
+    merge_wire path, so a malformed payload fails identically."""
+    sk = SlidingQuantile(
+        window_s=float(wire.get("window_s", DEFAULT_WINDOW_S)),
+        edges=wire.get("edges") or None,
+    )
+    sk.merge_wire(wire)
+    return sk.quantile(q)

@@ -277,7 +277,8 @@ def make_server(predictor, host: str = "127.0.0.1",
     _deadline_ms = scheduler.config.deadline_ms
 
     _known_paths = frozenset(
-        ("/healthz", "/readyz", "/metrics", "/slo", "/predict")
+        ("/healthz", "/readyz", "/metrics", "/slo", "/telemetry",
+         "/predict")
     )
 
     def _deadline_met(latency_ok: bool | None) -> bool | None:
@@ -305,6 +306,7 @@ def make_server(predictor, host: str = "127.0.0.1",
         # X-DSST-Trace, the last response code, and the scheduler's
         # accounting side channel — what the access-log row is built of.
         _trace_id = None
+        _trace_inherited = False
         _last_code = None
         _req_info = None
         _req_images = None
@@ -377,6 +379,15 @@ def make_server(predictor, host: str = "127.0.0.1",
                     # rates, and alert state (schema v1 — what
                     # `dsst slo` and `dsst top` consume).
                     self._json(200, slo_engine.render_status())
+                elif self.path == "/telemetry":
+                    # The federation plane: the full registry in RAW
+                    # mergeable form (per-bucket counts, window digest
+                    # internals) plus the SLO engine's measurement
+                    # windows — what a fleet aggregator folds into one
+                    # view (telemetry/federation.py).
+                    doc = telemetry.get_registry().wire_snapshot()
+                    doc["slo_sources"] = slo_engine.wire_sources()
+                    self._json(200, doc)
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
             finally:
@@ -414,6 +425,11 @@ def make_server(predictor, host: str = "127.0.0.1",
                     access.write({
                         "ts": round(time.time(), 3),
                         "request_id": self._trace_id,
+                        # Propagated (adopted from X-DSST-Trace) vs
+                        # minted here — the field that tells a router
+                        # hop apart from a direct client when
+                        # debugging fleet traces.
+                        "trace_inherited": self._trace_inherited,
                         "status": status,
                         "images": self._req_images,
                         "latency_ms": round(dur_s * 1000.0, 3),
@@ -430,13 +446,29 @@ def make_server(predictor, host: str = "127.0.0.1",
             if self.path != "/predict":
                 self._json(404, {"error": f"no route {self.path}"})
                 return
-            # One trace per request, opened at the HTTP edge: everything
+            # One trace per request, opened at the HTTP edge. A valid
+            # inbound X-DSST-Trace header (a client or router hop that
+            # already minted the unit's identity) is ADOPTED — its
+            # trace_id continues here, so the hop renders as one
+            # linked Perfetto flow. Malformed or absent mints fresh,
+            # exactly as before: from_header never raises on hostile
+            # input, it just yields an empty handoff. Everything
             # downstream (admission, decode pool, batcher) shares this
             # trace_id, and the response echoes it as X-DSST-Trace.
             self._last_code = None
             self._req_info = None
             self._req_images = None
-            with tracecontext.trace(kind="request") as tctx:
+            inbound = tracecontext.Handoff.from_header(
+                self.headers.get("X-DSST-Trace")
+            )
+            self._trace_inherited = inbound.ctx is not None
+            with tracecontext.trace(
+                kind="request",
+                trace_id=(
+                    inbound.ctx.trace_id if inbound.ctx is not None
+                    else None
+                ),
+            ) as tctx:
                 self._trace_id = tctx.trace_id
                 with telemetry.span("serve.request"):
                     self._post_predict()

@@ -64,6 +64,15 @@ if ! JAX_PLATFORMS=cpu timeout 120 python -m dss_ml_at_scale_tpu.config.cli slo 
   echo "preflight FAILED: dsst slo check found a burning objective - refusing to spend the TPU claim"
   exit 1
 fi
+# Fleet gate (the SLO plane at fleet scope): spawn TWO stub serving
+# replicas, drive propagated-trace traffic at each, then judge the
+# MERGED fleet view through `dsst slo check --fleet` — the aggregator
+# scrape, sketch federation, and fleet judgment all smoke-tested over
+# real processes before any multi-replica claim ships.
+if ! JAX_PLATFORMS=cpu timeout 300 python scripts/check_fleet_smoke.py; then
+  echo "preflight FAILED: 2-replica fleet smoke (slo check --fleet) - refusing to spend the TPU claim"
+  exit 1
+fi
 
 echo "== probe =="
 timeout 150 python - <<'EOF'

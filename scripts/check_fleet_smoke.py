@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Two-replica fleet observability smoke (CI preflight).
+
+Spawns TWO stub-scorer serving subprocesses (the same
+``bench.loadgen.spawn_stub_server`` path the serving bench uses),
+drives a little real traffic with propagated trace headers at each,
+then judges the FLEET through the real CLI:
+
+    dsst slo check --fleet 127.0.0.1:P1 127.0.0.1:P2
+
+Exit 0 means the whole plane held together end to end: both replicas
+served ``/telemetry``, the aggregator merged their registries and SLO
+windows inside its timeout budget, and no fleet-level objective is
+burning. Any crash, straggler-blocked scrape, or merged burn fails the
+preflight — exactly the multi-replica claim the TPU artifact pipeline
+wants gated before it publishes serving numbers.
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+
+def main() -> int:
+    from dss_ml_at_scale_tpu.bench.loadgen import (
+        run_load,
+        spawn_stub_server,
+    )
+    from dss_ml_at_scale_tpu.config.cli import main as dsst_main
+    from dss_ml_at_scale_tpu.telemetry import federation
+
+    procs = []
+    try:
+        endpoints = []
+        for _ in range(2):
+            proc, port = spawn_stub_server(score_ms=1.0,
+                                           batch_window_ms=1.0)
+            procs.append(proc)
+            endpoints.append(f"127.0.0.1:{port}")
+            report = run_load("127.0.0.1", port, b"0", threads=2,
+                              duration_s=1.0)
+            if report["requests"] == 0:
+                print(f"fleet smoke: no requests served by {port}",
+                      file=sys.stderr)
+                return 1
+            if report["trace_propagated"] != report["requests"]:
+                print(
+                    "fleet smoke: trace propagation broken "
+                    f"({report['trace_propagated']}/{report['requests']} "
+                    "echoed the injected trace id)",
+                    file=sys.stderr,
+                )
+                return 1
+
+        with tempfile.TemporaryDirectory() as td:
+            journal = Path(td) / "fleet.jsonl"
+            rc = dsst_main([
+                "slo", "check",
+                "--fleet", *endpoints,
+                "--fleet-journal", str(journal),
+            ])
+            if rc != 0:
+                print(f"fleet smoke: slo check --fleet exited {rc}",
+                      file=sys.stderr)
+                return 1
+            cycles = federation.read_fleet_journal(journal)
+            if not cycles or cycles[-1]["up"] != 2:
+                print(f"fleet smoke: journal shows {cycles!r}",
+                      file=sys.stderr)
+                return 1
+        print("fleet smoke: 2 replicas scraped, merged, and judged OK")
+        return 0
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            proc.wait(15)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

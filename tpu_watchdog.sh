@@ -69,7 +69,15 @@ if ! JAX_PLATFORMS=cpu timeout 120 python -m dss_ml_at_scale_tpu.config.cli \
   echo "$(date -u +%H:%M:%S) preflight FAILED: dsst slo check found a burning objective - watchdog refusing to arm" >> tpu_watchdog.log
   exit 1
 fi
-echo "$(date -u +%H:%M:%S) preflight clean: lint + audit + sanitize + bench + slo" >> tpu_watchdog.log
+# Fleet gate: 2 stub serving replicas, propagated-trace traffic, then
+# `dsst slo check --fleet` over the merged view (scrape + sketch
+# federation + fleet judgment smoke-tested over real processes).
+if ! JAX_PLATFORMS=cpu timeout 300 python scripts/check_fleet_smoke.py \
+    >> tpu_watchdog.log 2>&1; then
+  echo "$(date -u +%H:%M:%S) preflight FAILED: 2-replica fleet smoke (slo check --fleet) - watchdog refusing to arm" >> tpu_watchdog.log
+  exit 1
+fi
+echo "$(date -u +%H:%M:%S) preflight clean: lint + audit + sanitize + bench + slo + fleet" >> tpu_watchdog.log
 N=0
 while true; do
   if [ "$(date -u +%s)" -ge "$DEADLINE_EPOCH" ]; then
