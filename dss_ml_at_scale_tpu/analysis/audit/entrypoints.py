@@ -259,6 +259,74 @@ def decode_step_lm(mesh) -> ProgramSpec:
     )
 
 
+def slot_decode_lm(mesh) -> ProgramSpec:
+    """The continuous-batching serving step: vmapped decode over the
+    slot arena with a PER-SLOT position vector. The donation pin is the
+    whole point — the engine holds ONE live arena for the life of the
+    server, and this rule certifies every step aliases it in-place
+    (zero per-token cache copies)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...serving.lm import kvcache
+
+    task = _lm_task()
+    model = task.model
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    replicated = _replicated(mesh)
+    arena = jax.device_put(kvcache.make_arena(model, 4, 32), replicated)
+    variables = jax.device_put(variables, replicated)
+    tokens = jax.device_put(jnp.zeros((4,), jnp.int32), replicated)
+    pos = jax.device_put(jnp.zeros((4,), jnp.int32), replicated)
+    return ProgramSpec(
+        name="slot_decode.lm",
+        fn=kvcache.slot_decode,
+        args=(model, variables, tokens, arena, pos),
+        # out_shardings pinned for the same reason as decode_step.lm:
+        # committed inputs + UNSPECIFIED outputs silently drop the
+        # arena aliasing.
+        jit_kwargs={
+            "static_argnums": 0,
+            "donate_argnums": (3,),
+            "out_shardings": replicated,
+        },
+        expect_donated=(3,),
+    )
+
+
+def prefill_lm(mesh) -> ProgramSpec:
+    """One bucketed prefill (the canonical 16-token bucket): prompt
+    through one causal pass into a donated single-sequence cache the
+    engine recycles across admissions."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...serving.lm import kvcache
+
+    task = _lm_task()
+    model = task.model
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    replicated = _replicated(mesh)
+    cache = jax.device_put(kvcache.make_arena(model, 1, 32), replicated)
+    variables = jax.device_put(variables, replicated)
+    tokens = jax.device_put(jnp.zeros((1, 16), jnp.int32), replicated)
+    return ProgramSpec(
+        name="prefill.lm",
+        fn=kvcache.prefill_bucket,
+        args=(model, variables, tokens, cache),
+        jit_kwargs={
+            "static_argnums": 0,
+            "donate_argnums": (3,),
+            "out_shardings": replicated,
+        },
+        expect_donated=(3,),
+    )
+
+
 def serving_score(mesh) -> ProgramSpec:
     import jax
     import numpy as np
@@ -437,6 +505,8 @@ _BUILDERS: dict[str, Callable] = {
     "train_step.lm": train_step_lm,
     "train_step.pipelined_lm": train_step_pipelined_lm,
     "decode_step.lm": decode_step_lm,
+    "slot_decode.lm": slot_decode_lm,
+    "prefill.lm": prefill_lm,
     "serving.score": serving_score,
     "ops.fused_matmul.grad": fused_matmul_grad,
     "ops.fused_norm.grad": fused_norm_grad,

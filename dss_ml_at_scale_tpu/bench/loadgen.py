@@ -202,6 +202,140 @@ def run_load(host: str, port: int, body: bytes, *, threads: int,
     }
 
 
+# dsst: ignore[lock-discipline] cross-thread channels are the Barrier/Event; per-stream samples are written by the client thread alone and read only after join()
+class _LMClient(threading.Thread):
+    """One closed-loop token-stream client over ONE keep-alive
+    connection: POST /generate, read the chunked ndjson token-by-token
+    (TTFT = first line, inter-token = gap between lines), repeat."""
+
+    def __init__(self, host: str, port: int, body: bytes,
+                 barrier: threading.Barrier, stop: threading.Event):
+        super().__init__(daemon=True)
+        self.host, self.port, self.body = host, port, body
+        self.barrier, self.stop = barrier, stop
+        self.requests = 0
+        self.tokens = 0
+        self.ttfts: list[float] = []
+        self.gaps: list[float] = []
+        self.statuses: dict[int, int] = {}
+        self.errors = 0
+        self.propagated = 0
+
+    def run(self) -> None:
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=60)
+        self.barrier.wait()
+        while not self.stop.is_set():
+            handoff = Handoff.root("request")
+            t0 = time.perf_counter()
+            try:
+                conn.request(
+                    "POST", "/generate", body=self.body,
+                    headers={"Content-Type": "application/json",
+                             "X-DSST-Trace": handoff.to_header()},
+                )
+                resp = conn.getresponse()
+                status = resp.status
+                echoed = resp.getheader("X-DSST-Trace")
+                if status != 200:
+                    resp.read()
+                    self.statuses[status] = self.statuses.get(status, 0) + 1
+                    continue
+                # http.client decodes the chunked framing transparently;
+                # readline() therefore yields exactly one ndjson record
+                # per flushed server chunk — the timing boundary the
+                # TTFT/inter-token samples need.
+                last = None
+                done = False
+                for line in iter(resp.readline, b""):
+                    now = time.perf_counter()
+                    row = json.loads(line)
+                    if "done" in row:
+                        done = True
+                        break
+                    if last is None:
+                        self.ttfts.append(now - t0)
+                    else:
+                        self.gaps.append(now - last)
+                    last = now
+                    self.tokens += 1
+                resp.read()  # settle the connection for keep-alive
+                if not done:
+                    self.errors += 1
+                    raise OSError("stream ended without a done record")
+            except Exception:
+                self.errors += 1
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=60
+                )
+                continue
+            self.requests += 1
+            self.statuses[status] = self.statuses.get(status, 0) + 1
+            if echoed == handoff.ctx.trace_id:
+                self.propagated += 1
+        conn.close()
+
+
+def run_lm_load(host: str, port: int, *, prompt, max_new_tokens: int,
+                streams: int, duration_s: float) -> dict:
+    """Closed-loop streamed-generation load: ``streams`` concurrent
+    clients for ``duration_s``. The headline is tokens/sec; TTFT and
+    inter-token percentiles go through THE shared quantile helper
+    (``telemetry.windows.quantile``), so the offline numbers and the
+    live ``ttft_p99``/``inter_token_p99`` SLO windows can only differ
+    by sketch error, never by definition drift."""
+    body = json.dumps({
+        "tokens": list(prompt),
+        "max_new_tokens": int(max_new_tokens),
+    }).encode()
+    barrier = threading.Barrier(streams + 1)
+    stop = threading.Event()
+    clients = [_LMClient(host, port, body, barrier, stop)
+               for _ in range(streams)]
+    for c in clients:
+        c.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    time.sleep(duration_s)
+    stop.set()
+    for c in clients:
+        c.join(30)
+    wall = time.perf_counter() - t0
+
+    ttfts = sorted(x for c in clients for x in c.ttfts)
+    gaps = sorted(x for c in clients for x in c.gaps)
+    tokens = sum(c.tokens for c in clients)
+    requests = sum(c.requests for c in clients)
+    statuses: dict[str, int] = {}
+    for c in clients:
+        for code, n in c.statuses.items():
+            statuses[str(code)] = statuses.get(str(code), 0) + n
+
+    def pct(samples, p):
+        return quantile(samples, p) if samples else None
+
+    return {
+        "streams": streams,
+        "duration_s": round(wall, 3),
+        "requests": requests,
+        "tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 2),
+        "statuses": statuses,
+        "transport_errors": sum(c.errors for c in clients),
+        "trace_propagated": sum(c.propagated for c in clients),
+        "ttft_s": {
+            "p50": pct(ttfts, 0.50),
+            "p99": pct(ttfts, 0.99),
+            "mean": statistics.fmean(ttfts) if ttfts else None,
+        },
+        "inter_token_s": {
+            "p50": pct(gaps, 0.50),
+            "p99": pct(gaps, 0.99),
+            "mean": statistics.fmean(gaps) if gaps else None,
+        },
+    }
+
+
 class _StubScorer:
     """Predictor-shaped stub with a simulated per-batch score cost."""
 
@@ -267,6 +401,91 @@ def spawn_stub_server(*, micro_batch: int = 8, score_ms: float = 5.0,
     return proc, port
 
 
+def spawn_stub_lm_server(*, slots: int = 8, max_len: int = 96,
+                         prefill_buckets: str = "8,16",
+                         step_ms: float = 3.0, queue_depth: int = 32,
+                         deadline_ms: float = 0.0,
+                         inter_token_budget_ms: float = 0.0,
+                         access_log=None):
+    """Spawn the stub-decoder LM streaming server subprocess; returns
+    ``(proc, port)`` with ``/healthz`` already answering. Same
+    subprocess split and parent-death stdin channel as
+    :func:`spawn_stub_server` — the stub decoder's per-STEP cost is
+    independent of active slots, so this measures the ENGINE
+    (admission, continuous batching, streaming, retirement)."""
+    import subprocess
+
+    argv = [sys.executable, "-m", "dss_ml_at_scale_tpu.bench.loadgen",
+            "--stub-serve-lm",
+            "--slots", str(slots),
+            "--max-len", str(max_len),
+            "--prefill-buckets", str(prefill_buckets),
+            "--step-ms", str(step_ms),
+            "--queue-depth", str(queue_depth),
+            "--deadline-ms", str(deadline_ms),
+            "--inter-token-budget-ms", str(inter_token_budget_ms)]
+    if access_log is not None:
+        argv += ["--access-log", str(access_log)]
+    proc = subprocess.Popen(
+        argv,
+        stdout=subprocess.PIPE, stdin=subprocess.PIPE, text=True,
+    )
+    try:
+        boot = json.loads(proc.stdout.readline())
+        port = boot["port"]
+        _wait_ready("127.0.0.1", port)
+    except BaseException:
+        proc.terminate()
+        raise
+    return proc, port
+
+
+def _stub_serve_lm(args) -> int:
+    """The --stub-serve-lm server half: stub decoder + real engine +
+    real streaming front end; announce the port, serve until SIGTERM,
+    drain on the way out."""
+    import signal
+
+    from ..serving.lm import LMConfig, LMEngine, StubLMDecoder
+    from ..workloads.serving import serve_lm_in_thread
+
+    buckets = tuple(
+        int(b) for b in str(args.prefill_buckets).split(",") if b
+    )
+    cfg = LMConfig(
+        slots=args.slots, max_len=args.max_len, prefill_buckets=buckets,
+        queue_depth=args.queue_depth, deadline_ms=args.deadline_ms,
+        inter_token_budget_ms=args.inter_token_budget_ms,
+    )
+    engine = LMEngine(
+        StubLMDecoder(step_ms=args.step_ms, slots=args.slots,
+                      max_len=args.max_len, buckets=buckets),
+        cfg,
+    ).start()
+    handle = serve_lm_in_thread(engine, access_log=args.access_log or None)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+
+    def _watch_parent() -> None:
+        try:
+            sys.stdin.buffer.read()
+        except (OSError, ValueError):
+            pass
+        stop.set()
+
+    threading.Thread(target=_watch_parent, daemon=True,
+                     name="loadgen-parent-watch").start()
+    # dsst: ignore[no-print] subprocess port-announce protocol line on stdout
+    print(json.dumps({"port": handle.port}), flush=True)
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        handle.close()
+    return 0
+
+
 def _stub_serve(args) -> int:
     """The --stub-serve server half: announce the port, serve until
     SIGTERM, drain on the way out."""
@@ -326,6 +545,10 @@ def main(argv=None) -> int:
     # JSON line, serves until SIGTERM).
     target.add_argument("--stub-serve", action="store_true",
                         help=argparse.SUPPRESS)
+    # Internal: the LM-engine flavor (stub decoder + real continuous-
+    # batching engine + chunked /generate streaming).
+    target.add_argument("--stub-serve-lm", action="store_true",
+                        help=argparse.SUPPRESS)
     ap.add_argument("--image", default=None,
                     help="JPEG file to POST (required with --url)")
     ap.add_argument("--threads", type=int, default=16)
@@ -341,11 +564,23 @@ def main(argv=None) -> int:
                     help="(stub-serve) structured request log path")
     ap.add_argument("--flightrec", default=None,
                     help="(stub-serve) flight-recorder tail path")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="(stub-serve-lm) KV arena slots")
+    ap.add_argument("--max-len", type=int, default=96,
+                    help="(stub-serve-lm) per-slot KV capacity")
+    ap.add_argument("--prefill-buckets", default="8,16",
+                    help="(stub-serve-lm) comma-separated bucket lengths")
+    ap.add_argument("--step-ms", type=float, default=3.0,
+                    help="(stub-serve-lm) simulated per-STEP decode cost")
+    ap.add_argument("--inter-token-budget-ms", type=float, default=0.0,
+                    help="(stub-serve-lm) arms the inter_token_p99 SLO")
     ap.add_argument("--out", default=None, help="write the report JSON here")
     args = ap.parse_args(argv)
 
     if args.stub_serve:
         return _stub_serve(args)
+    if args.stub_serve_lm:
+        return _stub_serve_lm(args)
 
     proc = None
     if args.selftest:

@@ -882,3 +882,92 @@ register_scenario(Scenario(
     repetitions=3,
     timeout_s=240.0,
 ))
+
+
+# -- LM token serving ---------------------------------------------------------
+
+
+def _lm_serving_setup():
+    from . import loadgen
+
+    # Deadline + inter-token budget armed: the /slo snapshot riding the
+    # artifact must show ZERO firing objectives under this load (the
+    # acceptance gate `dsst slo check --strict --url` judges).
+    proc, port = loadgen.spawn_stub_lm_server(
+        slots=8, max_len=96, prefill_buckets="8,16", step_ms=3.0,
+        queue_depth=32, deadline_ms=2000.0, inter_token_budget_ms=250.0,
+    )
+    return {"proc": proc, "port": port}
+
+
+def _lm_serving_teardown(ctx) -> None:
+    ctx["proc"].terminate()
+    ctx["proc"].wait(15)
+
+
+def _lm_serving_measure(ctx) -> dict:
+    from . import loadgen
+
+    prompt = [1, 2, 3, 4]
+    # 8 concurrent streams vs ONE stream against the same engine: the
+    # stub decoder's per-STEP cost is independent of active slots, so
+    # the ratio isolates what continuous batching buys — the ISSUE's
+    # acceptance bar is >= 2x at 8 streams.
+    multi = loadgen.run_lm_load(
+        "127.0.0.1", ctx["port"], prompt=prompt, max_new_tokens=16,
+        streams=8, duration_s=1.2,
+    )
+    solo = loadgen.run_lm_load(
+        "127.0.0.1", ctx["port"], prompt=prompt, max_new_tokens=16,
+        streams=1, duration_s=0.8,
+    )
+    if multi["requests"] == 0 or solo["requests"] == 0:
+        raise RuntimeError(
+            f"lm loadgen starved: {multi['requests']} multi-stream / "
+            f"{solo['requests']} solo requests completed"
+        )
+    if multi["trace_propagated"] != multi["requests"]:
+        raise RuntimeError(
+            "trace propagation broken on /generate: "
+            f"{multi['trace_propagated']}/{multi['requests']} streams "
+            "echoed the injected trace id"
+        )
+    speedup = (
+        multi["tokens_per_sec"] / solo["tokens_per_sec"]
+        if solo["tokens_per_sec"] else 0.0
+    )
+    status = _scrape_slo(ctx["port"])
+    return {
+        "lm_tokens_per_sec": multi["tokens_per_sec"],
+        "lm_solo_tokens_per_sec": solo["tokens_per_sec"],
+        "lm_batching_speedup": round(speedup, 3),
+        "lm_ttft_p99_ms": (multi["ttft_s"]["p99"] or 0.0) * 1e3,
+        "lm_inter_token_p99_ms": (
+            multi["inter_token_s"]["p99"] or 0.0
+        ) * 1e3,
+        "_extra": {"loadgen": multi, "solo": solo, "slo": status},
+    }
+
+
+register_scenario(Scenario(
+    name="lm_serving",
+    description="closed-loop streamed-generation loadgen vs the "
+    "stub-decoder continuous-batching engine subprocess (slot "
+    "admission, bucketed prefill, chunked token streaming) — the "
+    "BENCH_lm_serving.json producer; gates tokens/sec and the "
+    ">=2x batching speedup at 8 streams",
+    tier="tier1",
+    metrics=(
+        Metric("lm_tokens_per_sec", "tokens/sec", "higher", floor=0.6),
+        Metric("lm_solo_tokens_per_sec", "tokens/sec", "higher",
+               gate=False),
+        Metric("lm_batching_speedup", "x", "higher", floor=0.6),
+        Metric("lm_ttft_p99_ms", "ms", "lower", gate=False),
+        Metric("lm_inter_token_p99_ms", "ms", "lower", gate=False),
+    ),
+    setup=_lm_serving_setup,
+    teardown=_lm_serving_teardown,
+    measure=_lm_serving_measure,
+    repetitions=3,
+    timeout_s=240.0,
+))

@@ -1829,6 +1829,162 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+def register_serve_lm(sub: argparse._SubParsersAction) -> None:
+    sv = sub.add_parser(
+        "serve-lm",
+        help="HTTP token-streaming LM server: continuous-batching decode "
+        "over preallocated KV slots; POST /generate streams one chunked "
+        "NDJSON line per token (plus a terminal done-line carrying the "
+        "trace id), GET /healthz + /readyz + /slo ride the same "
+        "keep-alive handler as dsst serve",
+    )
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8008)
+    sv.add_argument(
+        "--slots", type=int, default=8,
+        help="preallocated KV slots — the max generations decoding "
+        "concurrently in one slot_decode dispatch",
+    )
+    sv.add_argument(
+        "--max-len", type=int, default=256,
+        help="per-slot KV capacity; prompt + max_new_tokens beyond it "
+        "is rejected with 400 before admission",
+    )
+    sv.add_argument(
+        "--prefill-buckets", default="16,32,64", metavar="CSV",
+        help="padded prompt lengths the prefill program compiles for; "
+        "a prompt is padded up to the smallest bucket that fits",
+    )
+    sv.add_argument(
+        "--queue-depth", type=int, default=32,
+        help="max admitted-but-unslotted generations; beyond it "
+        "requests get 429 with a measured Retry-After",
+    )
+    sv.add_argument(
+        "--deadline-ms", type=float, default=0.0,
+        help="per-generation deadline: a slot past it is retired with "
+        "a streamed error instead of decoding late (0 disables); also "
+        "arms the ttft_p99 SLO budget",
+    )
+    sv.add_argument(
+        "--inter-token-budget-ms", type=float, default=0.0,
+        help="arms the inter_token_p99 SLO budget (0 leaves it "
+        "informational)",
+    )
+    sv.add_argument(
+        "--drain-timeout", type=float, default=10.0,
+        help="graceful-shutdown bound: seconds for in-flight streams "
+        "to finish after Ctrl-C before the server closes anyway",
+    )
+    sv.add_argument(
+        "--stub", action="store_true",
+        help="serve the deterministic stub decoder instead of a "
+        "TransformerLM — the full engine + streaming stack with no "
+        "device work (what the chaos/CI harnesses spawn)",
+    )
+    sv.add_argument(
+        "--step-ms", type=float, default=2.0,
+        help="stub-only: simulated wall time of one decode step "
+        "(charged once per step, not per active slot)",
+    )
+    sv.add_argument("--vocab", type=int, default=256,
+                    help="model/stub vocabulary size")
+    sv.add_argument("--dim", type=int, default=128)
+    sv.add_argument("--heads", type=int, default=4)
+    sv.add_argument("--layers", type=int, default=2)
+    sv.add_argument("--attention", choices=["flash", "reference"],
+                    default="reference")
+    sv.add_argument("--seed", type=int, default=0,
+                    help="init seed for the random-weight TransformerLM "
+                    "(no LM checkpoint format yet; serving a trained LM "
+                    "is gated on the lm checkpoint loader)")
+    sv.add_argument(
+        "--access-log", default=None, metavar="JSONL",
+        help="structured request log: one JSON line per /generate "
+        "(request_id matching the X-DSST-Trace header and the "
+        "done-line's trace field, status, tokens, ttft_ms)",
+    )
+    _add_tracking_args(sv, "serve-lm")
+    sv.set_defaults(fn=_cmd_serve_lm)
+
+
+def _cmd_serve_lm(args: argparse.Namespace) -> int:
+    from ..serving.lm import LMConfig, LMEngine, StubLMDecoder
+    from ..workloads.serving import serve_lm_in_thread
+
+    try:
+        buckets = tuple(
+            int(b) for b in str(args.prefill_buckets).split(",") if b
+        )
+        config = LMConfig(
+            slots=args.slots,
+            max_len=args.max_len,
+            prefill_buckets=buckets,
+            queue_depth=args.queue_depth,
+            deadline_ms=args.deadline_ms,
+            inter_token_budget_ms=args.inter_token_budget_ms,
+            drain_timeout_s=args.drain_timeout,
+        )
+    except ValueError as e:
+        print(e)
+        return 1
+    if args.stub:
+        decoder = StubLMDecoder(
+            vocab_size=args.vocab, step_ms=args.step_ms,
+            slots=args.slots, max_len=args.max_len,
+            buckets=config.prefill_buckets,
+        )
+    else:
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import TransformerLM
+        from ..serving.lm import TransformerDecoder
+
+        model = TransformerLM(
+            vocab_size=args.vocab, dim=args.dim, num_heads=args.heads,
+            num_layers=args.layers, max_seq=args.max_len,
+            attention=args.attention,
+        )
+        variables = model.init(
+            jax.random.PRNGKey(args.seed),
+            jnp.zeros((1, config.prefill_buckets[0]), jnp.int32),
+        )
+        decoder = TransformerDecoder(
+            model, variables, slots=args.slots, max_len=args.max_len,
+            buckets=config.prefill_buckets,
+        )
+    # The tracker's journaled start event (pid + boot id) is what lets
+    # `dsst runs doctor` classify a SIGKILL'd replica as INTERRUPTED —
+    # the chaos drill's whole observability story.
+    tracker = _open_tracker(args, "serve-lm")
+    if tracker is not None:
+        tracker.log_params(_args_params(args))
+    engine = LMEngine(decoder, config).start()
+    handle = serve_lm_in_thread(engine, args.host, args.port,
+                                access_log=args.access_log)
+    print(json.dumps({
+        "serving": handle.address,
+        "port": handle.port,
+        "decoder": type(decoder).__name__,
+        "slots": config.slots,
+        "max_len": config.max_len,
+        "prefill_buckets": list(config.prefill_buckets),
+        "queue_depth": config.queue_depth,
+        "deadline_ms": config.deadline_ms,
+    }), flush=True)
+    try:
+        while handle.thread.is_alive():
+            handle.thread.join(1.0)
+    except KeyboardInterrupt:
+        print(json.dumps({"draining": True, "pending": engine.pending}),
+              flush=True)
+    finally:
+        handle.close(args.drain_timeout)
+        _finish_tracker(tracker)
+    return 0
+
+
 def register_checkpoints(sub: argparse._SubParsersAction) -> None:
     ck = sub.add_parser(
         "checkpoints",
@@ -3780,6 +3936,7 @@ def register_all(sub: argparse._SubParsersAction) -> None:
     register_predict(sub)
     register_export(sub)
     register_serve(sub)
+    register_serve_lm(sub)
     register_lm(sub)
     register_hpo(sub)
     register_trial_worker(sub)

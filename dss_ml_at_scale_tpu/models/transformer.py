@@ -306,9 +306,21 @@ def generate(
     truncated to the ``top_k`` most likely tokens.
     """
     b, p = prompt.shape
+    cache = init_kv_cache(model, b)
+    # Cap against the CACHE SLAB, not the caller's arithmetic: the
+    # scatter at position ``pos`` is bounded by the preallocated k/v
+    # length (``cache[l]["k"].shape[2]``), so that shape — not whatever
+    # budget the caller computed — is the one capacity that matters.
+    # (Today the two agree at ``model.max_seq``; deriving from the
+    # buffer keeps the guard correct if they ever diverge, e.g. a
+    # short-arena cache like the serving tier's slot arenas.)
+    max_len = cache[0]["k"].shape[2]
     total = p + int(n_tokens)
-    if total > model.max_seq:
-        raise ValueError(f"prompt + n_tokens = {total} > max_seq {model.max_seq}")
+    if total > max_len:
+        raise ValueError(
+            f"prompt + n_tokens = {total} > max_seq {max_len} "
+            "(the preallocated KV-cache capacity)"
+        )
     if rng is None:
         rng = jax.random.key(0)
 
@@ -324,7 +336,6 @@ def generate(
     if n_tokens <= 0:
         return prompt
 
-    cache = init_kv_cache(model, b)
     try:
         prefill_logits, cache = model.apply(
             variables, prompt, cache=cache, pos=0

@@ -1,0 +1,39 @@
+"""Token-level LM serving: slot KV arenas + continuous batching.
+
+The subsystem between ``POST /generate`` (chunked token streaming in
+:mod:`..workloads.serving`) and the audited decode programs
+(:mod:`.kvcache`). See :mod:`.engine` for the decode-loop design and
+the README "LM serving" section for the operator view.
+"""
+
+from __future__ import annotations
+
+from .engine import (
+    Generation,
+    LMConfig,
+    LMEngine,
+    PromptTooLong,
+    StubLMDecoder,
+    TransformerDecoder,
+)
+from .kvcache import (
+    SlotAllocator,
+    make_arena,
+    prefill_bucket,
+    slot_decode,
+    write_slot,
+)
+
+__all__ = [
+    "Generation",
+    "LMConfig",
+    "LMEngine",
+    "PromptTooLong",
+    "SlotAllocator",
+    "StubLMDecoder",
+    "TransformerDecoder",
+    "make_arena",
+    "prefill_bucket",
+    "slot_decode",
+    "write_slot",
+]

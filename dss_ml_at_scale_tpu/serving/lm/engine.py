@@ -1,0 +1,567 @@
+"""Continuous-batching decode engine: token serving over slot arenas.
+
+Generalizes the image-serving scheduler (one compiled shape, batch
+ACROSS requests) to an always-running decode loop: requests are
+admitted INTO an in-flight batch. One engine thread alternates
+
+    admit waiting requests into free slots
+        (bucket-padded prefill, compiled once per bucket;
+         aliased scatter into the slot arena; first token = TTFT)
+    one ``slot_decode`` step over ALL slots
+        (every active request advances one token per step)
+    per-slot retirement
+        (EOS / max-token / deadline / cancel — the slot frees and the
+         batch keeps running; nothing stops, nothing recompiles)
+
+The HTTP layer talks to the engine through :meth:`LMEngine.submit`,
+which returns a :class:`Generation` whose event queue streams tokens
+to the response writer. Admission, deadline, and drain semantics are
+the image tier's, reused verbatim: a full queue raises
+:class:`~..admission.QueueFull` (429 + Retry-After), a draining engine
+raises :class:`~..admission.NotAccepting` (503), and drain = stop
+admitting, finish every in-flight slot.
+
+Two decoder backends satisfy the same five-method protocol
+(``prefill``/``step``/``warmup`` + ``slots``/``vocab_size``):
+:class:`TransformerDecoder` runs the real audited programs;
+:class:`StubLMDecoder` is the bench/CI stand-in whose per-STEP cost is
+independent of how many slots are active — exactly the property that
+makes continuous batching win, minus the model weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ... import telemetry
+from ..admission import AdmissionController, DeadlineExceeded, NotAccepting
+from . import kvcache
+
+
+class PromptTooLong(ValueError):
+    """Request exceeds the preallocated KV capacity (HTTP 400).
+
+    The guard the tentpole issue demands: an oversized budget must be
+    REJECTED before a slot is touched — never allowed to scatter past
+    the arena (the same cap ``models.transformer.generate`` now derives
+    from its cache shape).
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class LMConfig:
+    """Engine knobs — ``dsst serve-lm`` flags map 1:1."""
+
+    slots: int = 8
+    max_len: int = 128
+    prefill_buckets: tuple = (16, 32, 64)
+    queue_depth: int = 32
+    deadline_ms: float = 0.0  # admit -> last token; 0 disables
+    inter_token_budget_ms: float = 0.0  # arms inter_token_p99 when > 0
+    drain_timeout_s: float = 10.0
+
+    def __post_init__(self):
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {self.slots}")
+        buckets = tuple(sorted(set(int(b) for b in self.prefill_buckets)))
+        if not buckets:
+            raise ValueError("at least one prefill bucket is required")
+        if buckets[0] < 1 or buckets[-1] > self.max_len:
+            raise ValueError(
+                f"prefill buckets {buckets} must lie in [1, max_len="
+                f"{self.max_len}]"
+            )
+        object.__setattr__(self, "prefill_buckets", buckets)
+
+
+class Generation:
+    """One streamed request: engine-side state + client-side queue.
+
+    The engine thread owns the decode state (``n_past``, ``last_token``,
+    ``emitted``); the HTTP thread only reads the event queue and may set
+    ``cancelled`` (a latch, safe without the engine lock). Events are
+    ``("token", token, index)`` then exactly one terminal
+    ``("done", reason)`` or ``("error", exc)``.
+    """
+
+    def __init__(self, gen_id, prompt, max_new_tokens, *, temperature,
+                 top_k, eos_id, seed, trace_id, deadline):
+        self.gen_id = gen_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.trace_id = trace_id
+        self.deadline = deadline  # monotonic, or None
+        self.queue: queue.Queue = queue.Queue()
+        self.cancelled = False
+        self.reason: str | None = None
+        self.t_admit = time.monotonic()
+        self.t_first: float | None = None
+        self.t_last: float | None = None
+        # Engine-thread-only decode state.
+        self.n_past = 0
+        self.last_token = 0
+        self.emitted = 0
+        self._rng = np.random.default_rng(seed)
+
+    def sample(self, logits_row: np.ndarray) -> int:
+        if self.temperature <= 0.0:
+            return int(np.argmax(logits_row))
+        scaled = logits_row.astype(np.float64) / self.temperature
+        if self.top_k is not None:
+            kth = np.sort(scaled)[-self.top_k]
+            scaled = np.where(scaled < kth, -np.inf, scaled)
+        scaled -= scaled.max()
+        p = np.exp(scaled)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def next_event(self, timeout: float | None = None):
+        """Block for the next stream event (raises ``queue.Empty``)."""
+        return self.queue.get(timeout=timeout)
+
+    def cancel(self) -> None:
+        """Client went away: retire the slot at the next step."""
+        self.cancelled = True
+
+
+class TransformerDecoder:
+    """The real backend: audited slot-decode/prefill/scatter programs.
+
+    One compiled ``slot_decode`` for the life of the server (the arena
+    is donated through every call — aliased, never copied), one
+    ``prefill_bucket`` executable per configured bucket length, and a
+    donated ``write_slot`` scatter per admission. ``warmup()`` compiles
+    all of them before the server reports ready.
+    """
+
+    def __init__(self, model, variables, *, slots, max_len, buckets):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax, self._jnp = jax, jnp
+        self.model = model
+        self.variables = variables
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.buckets = tuple(buckets)
+        self.vocab_size = model.vocab_size
+        self._arena = kvcache.make_arena(model, self.slots, self.max_len)
+        # ONE prefill scratch cache, recycled: the returned (donated-in)
+        # buffers become the next call's input. Stale rows past the real
+        # prompt are never attended and are overwritten before the
+        # position pointer reaches them, so no re-zeroing is needed.
+        self._scratch = kvcache.make_arena(model, 1, self.max_len)
+        self._step_fn = jax.jit(
+            kvcache.slot_decode, static_argnums=0, donate_argnums=(3,)
+        )
+        self._prefill_fn = jax.jit(
+            kvcache.prefill_bucket, static_argnums=0, donate_argnums=(3,)
+        )
+        self._write_fn = jax.jit(kvcache.write_slot, donate_argnums=(0,))
+
+    def warmup(self) -> None:
+        """Compile every production shape before serving traffic."""
+        for bucket in self.buckets:
+            self.prefill(np.zeros((1, bucket), np.int32), 1, 0)
+        self.step(
+            np.zeros(self.slots, np.int32), np.zeros(self.slots, np.int32)
+        )
+
+    def prefill(self, tokens: np.ndarray, n_real: int, slot: int):
+        """Prefill one bucket-padded prompt and scatter it into ``slot``.
+
+        Returns the logits row of the last REAL prompt position (host
+        numpy) — what the first sampled token comes from.
+        """
+        jnp = self._jnp
+        logits, cache = self._prefill_fn(
+            self.model, self.variables,
+            jnp.asarray(tokens, jnp.int32), self._scratch,
+        )
+        self._arena = self._write_fn(self._arena, cache, jnp.int32(slot))
+        self._scratch = cache
+        row = logits[0] if logits.ndim == 2 else logits[0, n_real - 1]
+        return np.asarray(row, np.float32)
+
+    def step(self, tokens: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        """One ``slot_decode`` over every slot; returns [slots, vocab]."""
+        jnp = self._jnp
+        logits, self._arena = self._step_fn(
+            self.model, self.variables,
+            jnp.asarray(tokens, jnp.int32), self._arena,
+            jnp.asarray(pos, jnp.int32),
+        )
+        return np.asarray(logits, np.float32)
+
+
+class StubLMDecoder:
+    """Model-free backend for bench/CI: fixed per-STEP cost.
+
+    The next token is a pure function of (last token, position), so
+    streams are deterministic; ``step()`` sleeps ``step_ms`` ONCE no
+    matter how many slots are active — the continuous-batching speedup
+    the ``lm_serving`` bench gates is therefore structural, not noise.
+    Logits are one-hot so greedy sampling recovers the function exactly.
+    """
+
+    def __init__(self, *, vocab_size=256, step_ms=2.0, prefill_ms=None,
+                 slots=8, max_len=128, buckets=(16,)):
+        self.vocab_size = int(vocab_size)
+        self.step_ms = float(step_ms)
+        self.prefill_ms = float(
+            step_ms if prefill_ms is None else prefill_ms
+        )
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.buckets = tuple(buckets)
+
+    def _next(self, tok: int, pos: int) -> int:
+        return (int(tok) * 1103515245 + int(pos) * 12345 + 7) % self.vocab_size
+
+    def warmup(self) -> None:
+        pass
+
+    def prefill(self, tokens: np.ndarray, n_real: int, slot: int):
+        time.sleep(self.prefill_ms / 1000.0)
+        row = np.zeros(self.vocab_size, np.float32)
+        row[self._next(tokens[0, n_real - 1], n_real - 1)] = 1.0
+        return row
+
+    def step(self, tokens: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        time.sleep(self.step_ms / 1000.0)
+        out = np.zeros((self.slots, self.vocab_size), np.float32)
+        for i in range(self.slots):
+            out[i, self._next(tokens[i], pos[i])] = 1.0
+        return out
+
+
+class LMEngine:
+    """The always-running decode loop + admission front door."""
+
+    # Lint/sanitize contract: HTTP threads submit and drain; the engine
+    # thread admits, steps, and retires — the shared scheduling state
+    # below only moves under _cond.
+    _guarded_by_lock = ("_waiting", "_active", "_admitting", "_accepting",
+                        "_stopped")
+    _lock_name = "_cond"
+
+    def __init__(self, decoder, config: LMConfig | None = None):
+        self.cfg = config or LMConfig()
+        self.decoder = decoder
+        if getattr(decoder, "max_len", self.cfg.max_len) < self.cfg.max_len:
+            raise ValueError(
+                f"decoder max_len {decoder.max_len} < config max_len "
+                f"{self.cfg.max_len}"
+            )
+        if decoder.slots < self.cfg.slots:
+            raise ValueError(
+                f"decoder has {decoder.slots} slots, config wants "
+                f"{self.cfg.slots}"
+            )
+        self._alloc = kvcache.SlotAllocator(self.cfg.slots)
+        self._cond = threading.Condition()
+        self._waiting: list[Generation] = []
+        self._active: dict[int, Generation] = {}
+        # Generations pulled off _waiting but not yet in _active (their
+        # prefill is running): drain must see this in-transit window or
+        # it can declare the engine empty mid-admission and truncate a
+        # stream it promised to finish.
+        self._admitting = 0
+        self._accepting = True
+        self._stopped = False
+        self._gen_seq = 0
+        self._thread: threading.Thread | None = None
+        self._slo = telemetry.slo.get_engine()
+        self._admission = AdmissionController(
+            self.cfg.queue_depth,
+            on_depth=lambda n: self._depth_gauge.set(n),
+        )
+        self._depth_gauge = telemetry.gauge(
+            "lm_queue_depth", "LM generations admitted and not yet retired"
+        )
+        self._tokens_total = telemetry.counter(
+            "lm_tokens_total", "tokens streamed by the LM engine"
+        )
+        self._slots_gauge = telemetry.gauge(
+            "lm_slots_active", "KV arena slots currently decoding"
+        )
+        self._retired = telemetry.counter(
+            "lm_retired_total",
+            "generations retired, by reason",
+            labels=("reason",),
+        )
+        self._prefill_hist = telemetry.histogram(
+            "lm_prefill_seconds", "bucketed prefill latency (per admission)"
+        )
+        self._step_hist = telemetry.histogram(
+            "lm_decode_step_seconds", "slot_decode latency (per step)"
+        )
+        self._ttft_window = telemetry.window(
+            "lm_ttft_window_seconds",
+            "live windowed time-to-first-token (admit -> first chunk)",
+        )
+        self._inter_window = telemetry.window(
+            "lm_inter_token_window_seconds",
+            "live windowed gap between streamed tokens",
+        )
+
+    # -- front door (HTTP threads) ------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *, temperature=0.0,
+               top_k=None, eos_id=None, seed=0, trace_id=None) -> Generation:
+        """Admit one generation (or raise the HTTP-mapped refusal).
+
+        Raises :class:`PromptTooLong` (400) when the request cannot fit
+        the preallocated capacity, ``QueueFull`` (429) at the admission
+        bound, ``NotAccepting`` (503) while draining.
+        """
+        prompt = [int(t) for t in prompt]
+        n_new = int(max_new_tokens)
+        if not prompt:
+            raise ValueError("prompt must contain at least one token")
+        if n_new < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        vocab = self.decoder.vocab_size
+        if any(t < 0 or t >= vocab for t in prompt):
+            raise ValueError(f"prompt tokens must lie in [0, {vocab})")
+        buckets = self.cfg.prefill_buckets
+        if len(prompt) > buckets[-1]:
+            raise PromptTooLong(
+                f"prompt length {len(prompt)} exceeds the largest prefill "
+                f"bucket {buckets[-1]}"
+            )
+        if len(prompt) + n_new > self.cfg.max_len:
+            raise PromptTooLong(
+                f"prompt + max_new_tokens = {len(prompt) + n_new} > "
+                f"max_len {self.cfg.max_len} (preallocated KV slot capacity)"
+            )
+        deadline = None
+        if self.cfg.deadline_ms > 0:
+            deadline = time.monotonic() + self.cfg.deadline_ms / 1000.0
+        with self._cond:
+            if not self._accepting:
+                raise NotAccepting("LM engine is draining")
+            self._admission.admit(1)
+            self._gen_seq += 1
+            gen = Generation(
+                self._gen_seq, prompt, n_new, temperature=temperature,
+                top_k=top_k, eos_id=eos_id, seed=seed, trace_id=trace_id,
+                deadline=deadline,
+            )
+            self._waiting.append(gen)
+            self._cond.notify_all()
+        return gen
+
+    @property
+    def pending(self) -> int:
+        """Generations admitted and not yet retired (for drain prints)."""
+        return self._admission.pending
+
+    def start(self) -> "LMEngine":
+        """Arm SLO targets, warm the decoder, start the decode thread."""
+        if self.cfg.deadline_ms > 0:
+            # TTFT must beat the full-request deadline; arming turns the
+            # informational quantile objective into a judged one.
+            self._slo.set_target("ttft_p99", self.cfg.deadline_ms / 1000.0)
+        if self.cfg.inter_token_budget_ms > 0:
+            self._slo.set_target(
+                "inter_token_p99", self.cfg.inter_token_budget_ms / 1000.0
+            )
+        self.decoder.warmup()
+        self._thread = threading.Thread(
+            target=self._loop, name="lm-decode", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def drain(self, timeout_s: float | None = None) -> bool:
+        """Stop admitting, finish in-flight slots, stop the loop.
+
+        Returns True when everything retired within the budget; on
+        timeout the loop is stopped anyway and survivors are settled
+        with a ``("done", "drain")`` event so no client hangs forever.
+        """
+        budget = self.cfg.drain_timeout_s if timeout_s is None else timeout_s
+        deadline = time.monotonic() + max(0.0, budget)
+        with self._cond:
+            self._accepting = False
+            self._cond.notify_all()
+            while (
+                (self._waiting or self._active or self._admitting)
+                and not self._stopped
+            ):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(min(left, 0.1))
+            clean = (
+                not self._waiting and not self._active
+                and not self._admitting
+            )
+            self._stopped = True
+            self._cond.notify_all()
+        thread = self._thread
+        if thread is not None:
+            thread.join(5.0)
+        # Settle anything the budget abandoned (engine thread is gone).
+        with self._cond:
+            leftovers = list(self._waiting) + list(self._active.values())
+            self._waiting.clear()
+            self._active.clear()
+        for gen in leftovers:
+            self._settle(gen, "drain")
+        return clean
+
+    # -- engine thread ------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            admitted, expired, cancelled = [], [], []
+            with self._cond:
+                while (
+                    not self._stopped
+                    and not self._waiting
+                    and not self._active
+                ):
+                    self._cond.wait(0.05)
+                if self._stopped:
+                    return
+                now = time.monotonic()
+                still_waiting = []
+                for gen in self._waiting:
+                    if gen.cancelled:
+                        cancelled.append(gen)
+                        continue
+                    if gen.deadline is not None and now > gen.deadline:
+                        expired.append(gen)
+                        continue
+                    slot = self._alloc.alloc()
+                    if slot is None:
+                        still_waiting.append(gen)
+                    else:
+                        admitted.append((gen, slot))
+                self._waiting[:] = still_waiting
+                self._admitting += len(admitted)
+            for gen in cancelled:
+                self._settle(gen, "cancelled")
+            for gen in expired:
+                self._settle(gen, "deadline", error=True)
+            for gen, slot in admitted:
+                self._admit_into_slot(gen, slot)
+            if admitted:
+                with self._cond:
+                    self._admitting -= len(admitted)
+                    self._cond.notify_all()
+            self._step_once()
+
+    def _admit_into_slot(self, gen: Generation, slot: int) -> None:
+        """Bucketed prefill + scatter + first token (TTFT)."""
+        prompt = gen.prompt
+        bucket = next(
+            b for b in self.cfg.prefill_buckets if b >= len(prompt)
+        )
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(prompt)] = prompt
+        t0 = time.perf_counter()
+        with telemetry.span("lm.prefill", bucket=bucket,
+                            prompt_tokens=len(prompt)):
+            row = self.decoder.prefill(padded, len(prompt), slot)
+        self._prefill_hist.observe(time.perf_counter() - t0)
+        gen.n_past = len(prompt)
+        token = gen.sample(row)
+        now = time.monotonic()
+        ttft = now - gen.t_admit
+        gen.t_first = gen.t_last = now
+        self._emit(gen, token)
+        self._ttft_window.observe(ttft, gen.trace_id)
+        self._slo.note_ttft(ttft, trace_id=gen.trace_id)
+        if self._should_retire(gen, token):
+            self._retire_slot(slot, gen)
+            return
+        with self._cond:
+            self._active[slot] = gen
+            self._slots_gauge.set(len(self._active))
+
+    def _step_once(self) -> None:
+        with self._cond:
+            active = dict(self._active)
+        if not active:
+            return
+        tokens = np.zeros(self.cfg.slots, np.int32)
+        pos = np.zeros(self.cfg.slots, np.int32)
+        for slot, gen in active.items():
+            tokens[slot] = gen.last_token
+            pos[slot] = gen.n_past
+        t0 = time.perf_counter()
+        with telemetry.span("lm.step", active=len(active)):
+            logits = self.decoder.step(tokens, pos)
+        self._step_hist.observe(time.perf_counter() - t0)
+        now = time.monotonic()
+        for slot in sorted(active):
+            gen = active[slot]
+            gen.n_past += 1
+            if gen.cancelled:
+                self._retire_slot(slot, gen, reason="cancelled")
+                continue
+            if gen.deadline is not None and now > gen.deadline:
+                self._retire_slot(slot, gen, reason="deadline")
+                continue
+            token = gen.sample(logits[slot])
+            gap = now - (gen.t_last if gen.t_last is not None else now)
+            gen.t_last = now
+            self._emit(gen, token)
+            self._inter_window.observe(gap, gen.trace_id)
+            self._slo.note_inter_token(gap, trace_id=gen.trace_id)
+            if self._should_retire(gen, token):
+                self._retire_slot(slot, gen)
+
+    def _emit(self, gen: Generation, token: int) -> None:
+        gen.last_token = token
+        gen.queue.put(("token", token, gen.emitted))
+        gen.emitted += 1
+        self._tokens_total.inc()
+
+    def _should_retire(self, gen: Generation, token: int) -> bool:
+        if gen.eos_id is not None and token == gen.eos_id:
+            gen.reason = "eos"
+            return True
+        if gen.emitted >= gen.max_new_tokens:
+            gen.reason = "max_tokens"
+            return True
+        return False
+
+    def _retire_slot(self, slot: int, gen: Generation,
+                     reason: str | None = None) -> None:
+        with self._cond:
+            self._active.pop(slot, None)
+            self._slots_gauge.set(len(self._active))
+            self._cond.notify_all()
+        self._alloc.free(slot)
+        wall = time.monotonic() - gen.t_admit
+        # Seconds-per-generation normalized by slot count: the cost one
+        # admission adds to the shared step loop, feeding Retry-After.
+        self._admission.note_service_rate(wall / max(1, self.cfg.slots))
+        self._settle(gen, reason or gen.reason or "done")
+
+    def _settle(self, gen: Generation, reason: str,
+                error: bool = False) -> None:
+        if gen.reason is None:
+            gen.reason = reason
+        if error:
+            gen.queue.put(("error", DeadlineExceeded(
+                "deadline passed before a slot freed"
+            )))
+        else:
+            gen.queue.put(("done", gen.reason))
+        self._retired.labels(reason=gen.reason).inc()
+        self._admission.release(1)

@@ -88,6 +88,15 @@ KNOWN_METRICS: dict[str, str] = {
     "slo_alert_transitions_total": "counter",
     "slo_alerts_firing": "gauge",
     "train_step_window_seconds": "window",
+    # -- LM token serving --------------------------------------------------
+    "lm_decode_step_seconds": "histogram",
+    "lm_inter_token_window_seconds": "window",
+    "lm_prefill_seconds": "histogram",
+    "lm_queue_depth": "gauge",
+    "lm_retired_total": "counter",
+    "lm_slots_active": "gauge",
+    "lm_tokens_total": "counter",
+    "lm_ttft_window_seconds": "window",
     # -- serving -----------------------------------------------------------
     "predict_batch_seconds": "histogram",
     "predict_errors_total": "counter",
@@ -131,6 +140,12 @@ KNOWN_SPANS: dict[str, str] = {
     "serve.decode": "decode pool turning one request's payloads into "
                     "arrays",
     "serve.score": "one request's share of a scored micro-batch",
+    "serve.generate": "one HTTP /generate request, admission to the "
+                      "final streamed chunk",
+    "lm.prefill": "one bucket-padded prompt prefill + arena scatter "
+                  "(admission into a free slot)",
+    "lm.step": "one slot_decode dispatch over every slot (all active "
+               "generations advance one token)",
     # -- HPO ---------------------------------------------------------------
     "trial": "one HPO trial evaluation",
     "trial.submit": "driver-side proposal/submission of one trial",
@@ -162,6 +177,10 @@ KNOWN_SLOS: dict[str, str] = {
                              "queue stays under 1%",
     "train_step_p95": "windowed p95 train-step seconds vs the armed "
                       "step budget",
+    "ttft_p99": "windowed p99 time-to-first-token (admit -> first "
+                "streamed chunk) vs the armed TTFT budget",
+    "inter_token_p99": "windowed p99 gap between consecutive streamed "
+                       "tokens vs the armed per-token budget",
 }
 
 # Span name -> attribution bucket: where a step's wall time went. The
@@ -177,6 +196,8 @@ SPAN_ATTRIBUTION: dict[str, str] = {
     "train_step": "compute",
     "panel.build": "host",
     "grid.chunk": "compute",
+    "lm.prefill": "compute",
+    "lm.step": "compute",
 }
 
 # Scenario name -> the exact metric keys its schema may emit
@@ -227,6 +248,13 @@ KNOWN_BENCH_METRICS: dict[str, tuple[str, ...]] = {
         "serving_p99_ms",
         "serving_batch_fill_mean",
         "serving_live_p99_ms",
+    ),
+    "lm_serving": (
+        "lm_tokens_per_sec",
+        "lm_solo_tokens_per_sec",
+        "lm_batching_speedup",
+        "lm_ttft_p99_ms",
+        "lm_inter_token_p99_ms",
     ),
     "slo_overhead": (
         "slo_sketch_observe_us",

@@ -145,6 +145,26 @@ def default_objectives() -> tuple[Objective, ...]:
             quantile=0.95,
             unit="s",
         ),
+        Objective(
+            name="ttft_p99",
+            description="windowed p99 time-to-first-token (admit -> "
+            "first streamed chunk) vs the armed TTFT budget; the LM "
+            "engine arms it with its request deadline",
+            kind="quantile",
+            target=None,
+            quantile=0.99,
+            unit="s",
+        ),
+        Objective(
+            name="inter_token_p99",
+            description="windowed p99 gap between consecutive streamed "
+            "tokens vs the armed per-token budget (informational until "
+            "armed via --inter-token-budget-ms)",
+            kind="quantile",
+            target=None,
+            quantile=0.99,
+            unit="s",
+        ),
     )
 
 
@@ -562,6 +582,22 @@ class SloEngine:
     def note_train_step(self, dur_s: float,
                         trace_id: str | None = None) -> None:
         src = self._sources.get("train_step_p95")
+        if src is not None:
+            src.note(dur_s, trace=trace_id)
+        self.maybe_evaluate()
+
+    def note_ttft(self, dur_s: float,
+                  trace_id: str | None = None) -> None:
+        """Admit -> first streamed chunk, fed per LM admission."""
+        src = self._sources.get("ttft_p99")
+        if src is not None:
+            src.note(dur_s, trace=trace_id)
+        self.maybe_evaluate()
+
+    def note_inter_token(self, dur_s: float,
+                         trace_id: str | None = None) -> None:
+        """Gap between consecutive streamed chunks of one generation."""
+        src = self._sources.get("inter_token_p99")
         if src is not None:
             src.note(dur_s, trace=trace_id)
         self.maybe_evaluate()

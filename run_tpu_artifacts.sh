@@ -34,10 +34,11 @@ if ! JAX_PLATFORMS=cpu timeout 600 python -m dss_ml_at_scale_tpu.config.cli sani
 fi
 # Fourth tier: the tier-1 bench scenarios against the committed
 # BENCH_BASELINE.json — a host-side performance regression (decode,
-# reader, scheduler, recorder overhead) measured BEFORE the claim is a
-# finding on CPU time, not a mystery in the on-chip numbers. 2100s
-# exceeds the sum of tier-1 per-scenario child timeouts (~1680s with
-# the group_fit grid launch), so a
+# reader, scheduler, recorder overhead, LM continuous-batching
+# throughput) measured BEFORE the claim is a finding on CPU time, not
+# a mystery in the on-chip numbers. 2100s exceeds the sum of tier-1
+# per-scenario child timeouts (~1920s with the group_fit grid launch
+# and the lm_serving stream), so a
 # hung scenario dies to ITS watchdog (per-scenario finding + salvage)
 # rather than this blanket kill.
 # NOTE: baselines are environment-fingerprinted; on a host with no
@@ -68,9 +69,12 @@ fi
 # replicas, drive propagated-trace traffic at each, then judge the
 # MERGED fleet view through `dsst slo check --fleet` — the aggregator
 # scrape, sketch federation, and fleet judgment all smoke-tested over
-# real processes before any multi-replica claim ships.
+# real processes before any multi-replica claim ships. A third stub
+# replica runs the LM tier: a propagated-trace streamed generation
+# through the continuous-batching engine, then `dsst slo check
+# --strict` on its armed TTFT/inter-token objectives.
 if ! JAX_PLATFORMS=cpu timeout 300 python scripts/check_fleet_smoke.py; then
-  echo "preflight FAILED: 2-replica fleet smoke (slo check --fleet) - refusing to spend the TPU claim"
+  echo "preflight FAILED: fleet smoke (slo check --fleet + LM stream gate) - refusing to spend the TPU claim"
   exit 1
 fi
 

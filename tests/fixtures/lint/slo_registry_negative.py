@@ -1,5 +1,6 @@
 """slo-registry negative fixture: clean against
-``known={"serving_latency_p99": "..."}``."""
+``known={"serving_latency_p99": "...", "ttft_p99": "...",
+"inter_token_p99": "..."}``."""
 
 
 def build(engine):
@@ -8,6 +9,14 @@ def build(engine):
         target=0.99,
     )
     engine.set_target("serving_latency_p99", 0.95)
+    # The LM-serving shape: informational quantile objectives declared
+    # with target=None, armed later by the engine's start().
+    Objective(name="ttft_p99", description="", kind="quantile",
+              target=None, quantile=0.99, unit="s")
+    Objective(name="inter_token_p99", description="", kind="quantile",
+              target=None, quantile=0.99, unit="s")
+    engine.set_target("ttft_p99", 2.0)
+    engine.set_target("inter_token_p99", 0.25)
     # A suppressed computed name carries its audit trail in source:
     # dsst: ignore[slo-registry] test-harness objective built from a parametrized name
     dynamic = Objective(name=f"{obj.name}_shadow", description="",

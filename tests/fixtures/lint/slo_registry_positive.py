@@ -1,10 +1,12 @@
-"""slo-registry positive fixture: 4 findings expected.
+"""slo-registry positive fixture: 5 findings expected.
 
 Checker is constructed with
-``known={"serving_latency_p99": "...", "dead_slo": "..."}``:
+``known={"serving_latency_p99": "...", "ttft_p99": "...",
+"dead_slo": "..."}``:
 an undeclared Objective name, a non-literal Objective name, an
-undeclared set_target reference, and the dead ``dead_slo`` catalog
-entry (finalize).
+undeclared set_target reference, an undeclared LM-tier arming
+reference (set_target on a quantile objective nobody declared), and
+the dead ``dead_slo`` catalog entry (finalize).
 """
 
 
@@ -19,9 +21,16 @@ def build(engine, make_name):
         # declared: keeps serving_latency_p99 alive
         Objective(name="serving_latency_p99", description="",
                   kind="events", target=0.99),
+        # declared informational quantile (armed below): keeps ttft_p99
+        # alive — the LM-serving objective shape
+        Objective(name="ttft_p99", description="", kind="quantile",
+                  target=None, quantile=0.99, unit="s"),
     ]
     # undeclared reference -> finding
     engine.set_target("unknown_slo", 1.0)
-    # declared reference: clean
+    # undeclared LM-tier arming reference -> finding
+    engine.set_target("inter_token_p99", 0.25)
+    # declared references: clean
     engine.set_target("serving_latency_p99", 0.95)
+    engine.set_target("ttft_p99", 2.0)
     return objs

@@ -173,12 +173,14 @@ RULES = {
     ),
     "slo_registry_pos": (
         lambda: SloRegistryChecker(known={
-            "serving_latency_p99": "latency", "dead_slo": "unmeasured",
-        }), 4,
+            "serving_latency_p99": "latency", "ttft_p99": "first token",
+            "dead_slo": "unmeasured",
+        }), 5,
     ),
     "slo_registry_neg": (
         lambda: SloRegistryChecker(known={
-            "serving_latency_p99": "latency",
+            "serving_latency_p99": "latency", "ttft_p99": "first token",
+            "inter_token_p99": "token gap",
         }), None,
     ),
 }

@@ -1,0 +1,394 @@
+"""LM token serving (serving/lm/, `dsst serve-lm`).
+
+The continuous-batching contract, layer by layer:
+
+- slot arena: alloc/free/reuse churn, double-free refusal;
+- engine semantics over the stub decoder: deterministic streams under
+  churn, capacity refusals BEFORE a slot is touched, deadline
+  retirement (both the in-slot and the never-slotted flavors), drain =
+  finish in-flight then refuse;
+- numerics: a churned engine over the real TransformerDecoder streams
+  bitwise the same tokens as solo decoding and as
+  ``models.transformer.generate`` — continuous batching is a
+  scheduling change, not a numerics change;
+- HTTP: the streamed done-line's trace id matches the access-log row
+  (the cross-process observability hop), oversized requests are 400;
+- chaos: a SIGKILLed `dsst serve-lm` replica leaves no torn tracking
+  state and `dsst runs doctor` classifies it INTERRUPTED.
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dss_ml_at_scale_tpu.serving.admission import (
+    DeadlineExceeded,
+    NotAccepting,
+)
+from dss_ml_at_scale_tpu.serving.lm import (
+    LMConfig,
+    LMEngine,
+    PromptTooLong,
+    SlotAllocator,
+    StubLMDecoder,
+)
+
+
+def _collect(gen, timeout=30.0):
+    """Drain one generation's event stream: (tokens, terminal_event)."""
+    tokens = []
+    while True:
+        event = gen.next_event(timeout=timeout)
+        if event[0] == "token":
+            tokens.append(event[1])
+        else:
+            return tokens, event
+
+
+def _stub_expected(decoder, prompt, n_tokens):
+    """The stub's closed-form greedy stream for ``prompt``."""
+    out = []
+    tok, pos = prompt[-1], len(prompt) - 1
+    for _ in range(n_tokens):
+        tok = decoder._next(tok, pos)
+        out.append(tok)
+        pos += 1
+    return out
+
+
+# -- slot arena ------------------------------------------------------------
+
+
+def test_slot_allocator_churn():
+    alloc = SlotAllocator(3)
+    assert [alloc.alloc() for _ in range(3)] == [0, 1, 2]
+    assert alloc.alloc() is None
+    alloc.free(1)
+    assert alloc.n_free == 1 and alloc.n_used == 2
+    # Freed slot is reused, lowest-first.
+    assert alloc.alloc() == 1
+    alloc.free(0)
+    alloc.free(2)
+    with pytest.raises(ValueError):
+        alloc.free(2)  # double free
+    with pytest.raises(ValueError):
+        alloc.free(7)  # never allocated
+
+
+# -- engine over the stub decoder ------------------------------------------
+
+
+@pytest.fixture
+def stub_engine():
+    cfg = LMConfig(slots=3, max_len=48, prefill_buckets=(8, 16),
+                   queue_depth=16)
+    engine = LMEngine(
+        StubLMDecoder(vocab_size=97, step_ms=1.0, slots=3, max_len=48,
+                      buckets=(8, 16)),
+        cfg,
+    ).start()
+    yield engine
+    engine.drain(5.0)
+
+
+def test_streams_deterministic_under_slot_churn(stub_engine):
+    """8 generations over 3 slots: every stream matches the stub's
+    closed form even though slots free and refill mid-flight."""
+    prompts = [[(3 * i + j) % 97 for j in range(2 + i % 7)]
+               for i in range(8)]
+    gens = [stub_engine.submit(p, 6, seed=i)
+            for i, p in enumerate(prompts)]
+    for prompt, gen in zip(prompts, gens):
+        tokens, terminal = _collect(gen)
+        assert terminal == ("done", "max_tokens")
+        assert tokens == _stub_expected(stub_engine.decoder, prompt, 6)
+    # Every slot returned to the arena.
+    assert stub_engine._alloc.n_used == 0
+    assert stub_engine.pending == 0
+
+
+def test_eos_retires_early(stub_engine):
+    prompt = [5, 9]
+    expected = _stub_expected(stub_engine.decoder, prompt, 8)
+    eos = expected[3]
+    gen = stub_engine.submit(prompt, 8, eos_id=eos)
+    tokens, terminal = _collect(gen)
+    assert terminal == ("done", "eos")
+    assert tokens == expected[:4]  # eos token itself is streamed
+
+
+def test_capacity_refusals_before_any_slot(stub_engine):
+    with pytest.raises(PromptTooLong, match="largest prefill bucket"):
+        stub_engine.submit(list(range(17)), 4)
+    with pytest.raises(PromptTooLong, match="preallocated KV slot"):
+        stub_engine.submit([1, 2, 3], 46)
+    with pytest.raises(ValueError, match="at least one token"):
+        stub_engine.submit([], 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        stub_engine.submit([1], 0)
+    with pytest.raises(ValueError, match="lie in"):
+        stub_engine.submit([97], 4)
+    # Nothing was admitted by any refusal.
+    assert stub_engine.pending == 0
+
+
+def test_deadline_retires_slot_and_frees_it():
+    cfg = LMConfig(slots=1, max_len=64, prefill_buckets=(8,),
+                   deadline_ms=150.0)
+    engine = LMEngine(
+        StubLMDecoder(step_ms=30.0, slots=1, max_len=64, buckets=(8,)),
+        cfg,
+    ).start()
+    try:
+        gen = engine.submit([1, 2], 60)
+        tokens, terminal = _collect(gen)
+        assert terminal == ("done", "deadline")
+        assert 0 < len(tokens) < 60
+        # The slot is free again: a request that fits the budget runs.
+        gen2 = engine.submit([1, 2], 2)
+        tokens2, terminal2 = _collect(gen2)
+        assert terminal2 == ("done", "max_tokens")
+        assert len(tokens2) == 2
+        assert engine._alloc.n_used == 0
+    finally:
+        engine.drain(5.0)
+
+
+def test_deadline_expires_while_waiting_for_a_slot():
+    """A request whose deadline passes before a slot ever frees gets
+    the queue-jump error event, not a truncated stream."""
+    cfg = LMConfig(slots=1, max_len=64, prefill_buckets=(8,),
+                   deadline_ms=120.0)
+    engine = LMEngine(
+        StubLMDecoder(step_ms=25.0, slots=1, max_len=64, buckets=(8,)),
+        cfg,
+    ).start()
+    try:
+        hog = engine.submit([1], 60)  # occupies the only slot past 120ms
+        starved = engine.submit([2], 4)
+        tokens, terminal = _collect(starved)
+        assert tokens == []
+        assert terminal[0] == "error"
+        assert isinstance(terminal[1], DeadlineExceeded)
+        _collect(hog)  # hog itself retires on ITS deadline
+    finally:
+        engine.drain(5.0)
+
+
+def test_drain_finishes_inflight_then_refuses(stub_engine):
+    gen = stub_engine.submit([1, 2, 3], 12)
+    got = {}
+
+    def _reader():
+        got["tokens"], got["terminal"] = _collect(gen)
+
+    reader = threading.Thread(target=_reader)
+    reader.start()
+    assert stub_engine.drain(10.0) is True
+    reader.join(10.0)
+    # The in-flight stream COMPLETED during drain — not truncated.
+    assert got["terminal"] == ("done", "max_tokens")
+    assert len(got["tokens"]) == 12
+    with pytest.raises(NotAccepting):
+        stub_engine.submit([1], 1)
+
+
+# -- numerics: churned engine == solo == generate() ------------------------
+
+
+def test_parity_churn_vs_solo_vs_generate(devices8):
+    """Continuous batching must be bitwise a scheduling change: tokens
+    from a churned multi-slot engine == solo decoding == the model's
+    own ``generate`` reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from dss_ml_at_scale_tpu.models import TransformerLM
+    from dss_ml_at_scale_tpu.models.transformer import generate
+    from dss_ml_at_scale_tpu.serving.lm import TransformerDecoder
+
+    model = TransformerLM(vocab_size=64, dim=32, num_heads=4,
+                          num_layers=2, max_seq=64, dtype=jnp.float32,
+                          attention="reference")
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(7)
+    prompts = [list(rng.integers(1, 64, int(n))) for n in (3, 7, 11, 5, 14)]
+    n_new = 6
+
+    def _reference(prompt):
+        out = generate(model, variables,
+                       jnp.asarray([prompt], jnp.int32), n_new)
+        return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+    expected = [_reference(p) for p in prompts]
+
+    # Solo: one generation at a time through a 1-slot engine.
+    solo = LMEngine(
+        TransformerDecoder(model, variables, slots=1, max_len=48,
+                           buckets=(8, 16)),
+        LMConfig(slots=1, max_len=48, prefill_buckets=(8, 16)),
+    ).start()
+    try:
+        for prompt, want in zip(prompts, expected):
+            tokens, terminal = _collect(solo.submit(prompt, n_new))
+            assert terminal == ("done", "max_tokens")
+            assert tokens == want
+    finally:
+        solo.drain(10.0)
+
+    # Churned: 5 staggered generations over 3 slots — admissions land
+    # BETWEEN other streams' decode steps, slots free and refill.
+    churn = LMEngine(
+        TransformerDecoder(model, variables, slots=3, max_len=48,
+                           buckets=(8, 16)),
+        LMConfig(slots=3, max_len=48, prefill_buckets=(8, 16)),
+    ).start()
+    try:
+        gens = []
+        for prompt in prompts:
+            gens.append(churn.submit(prompt, n_new))
+            time.sleep(0.02)
+        for want, gen in zip(expected, gens):
+            tokens, terminal = _collect(gen, timeout=60.0)
+            assert terminal == ("done", "max_tokens")
+            assert tokens == want
+    finally:
+        churn.drain(10.0)
+
+
+# -- HTTP streaming --------------------------------------------------------
+
+
+@pytest.fixture
+def lm_server(tmp_path):
+    from dss_ml_at_scale_tpu.workloads.serving import serve_lm_in_thread
+
+    cfg = LMConfig(slots=2, max_len=48, prefill_buckets=(8,),
+                   queue_depth=8)
+    engine = LMEngine(
+        StubLMDecoder(step_ms=1.0, slots=2, max_len=48, buckets=(8,)),
+        cfg,
+    ).start()
+    log = tmp_path / "access.jsonl"
+    handle = serve_lm_in_thread(engine, access_log=log)
+    yield handle, log
+    handle.close()
+
+
+def _stream(port, payload, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", "/generate", json.dumps(payload).encode(),
+                 {"Content-Type": "application/json", **(headers or {})})
+    resp = conn.getresponse()
+    if resp.status != 200:
+        body = json.loads(resp.read())
+        conn.close()
+        return resp.status, resp.getheader("X-DSST-Trace"), [], body
+    lines = []
+    for raw in iter(resp.readline, b""):
+        lines.append(json.loads(raw))
+        if "done" in lines[-1]:
+            break
+    resp.read()
+    trace = resp.getheader("X-DSST-Trace")
+    conn.close()
+    return resp.status, trace, lines[:-1], lines[-1]
+
+
+def test_streamed_trace_matches_access_log(lm_server):
+    """The cross-process observability hop: an injected trace id comes
+    back on the response header AND the done-line AND the access-log
+    row — one trace across client, stream, and log."""
+    handle, log = lm_server
+    injected = "feedc0de12345678"
+    header = f"dsst1-{injected}-abcd1234-request"
+    status, trace, tokens, done = _stream(
+        handle.port, {"tokens": [1, 2, 3], "max_new_tokens": 4},
+        headers={"X-DSST-Trace": header},
+    )
+    assert status == 200
+    assert trace == injected
+    assert done["done"] == "max_tokens"
+    assert done["trace"] == injected
+    assert len(tokens) == 4
+    rows = [json.loads(l) for l in log.read_text().splitlines()]
+    row = next(r for r in rows if r["request_id"] == injected)
+    assert row["trace_inherited"] is True
+    assert row["status"] == 200
+    assert row["tokens"] == 4
+    assert row["reason"] == "max_tokens"
+    assert row["ttft_ms"] >= 0
+
+
+def test_oversized_request_is_400_not_a_scatter(lm_server):
+    handle, _ = lm_server
+    status, _, _, body = _stream(
+        handle.port, {"tokens": list(range(1, 10)), "max_new_tokens": 4})
+    assert status == 400
+    assert "bucket" in body["error"]
+    status, _, _, body = _stream(
+        handle.port, {"tokens": [1, 2], "max_new_tokens": 47})
+    assert status == 400
+    assert "max_len" in body["error"]
+    # The server is still healthy after both refusals.
+    status, _, tokens, done = _stream(
+        handle.port, {"tokens": [1, 2], "max_new_tokens": 3})
+    assert status == 200 and len(tokens) == 3
+
+
+# -- chaos: SIGKILL a replica, doctor classifies it ------------------------
+
+
+def test_sigkill_replica_classified_interrupted(tmp_path, capsys):
+    """One chaos cycle against `dsst serve-lm --stub`: stream mid-kill,
+    then assert no torn tracking state and a doctor INTERRUPTED verdict
+    — the serving face of the crash-only runtime."""
+    from dss_ml_at_scale_tpu.config.cli import main
+
+    root = tmp_path / "runs"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dss_ml_at_scale_tpu.config.cli",
+         "serve-lm", "--stub", "--port", "0", "--slots", "2",
+         "--max-len", "32", "--prefill-buckets", "8",
+         "--step-ms", "20", "--tracking-root", str(root)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        boot = json.loads(proc.stdout.readline())
+        port = boot["port"]
+        # A stream is mid-flight when the kill lands.
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request(
+            "POST", "/generate",
+            json.dumps({"tokens": [1, 2], "max_new_tokens": 30}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.readline()  # first token arrived — decode is running
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+    conn.close()
+    assert proc.returncode == -signal.SIGKILL
+    # Crash-only tracking: no torn temp files stranded anywhere.
+    assert list(root.rglob("*.tmp")) == []
+    # Doctor flips the dead-PID RUNNING run to INTERRUPTED.
+    assert main(["runs", "doctor", "--tracking-root", str(root),
+                 "--json"]) == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    runs = [r for r in report["runs"] if r["experiment"] == "serve-lm"]
+    assert len(runs) == 1
+    assert runs[0]["effective_status"] == "INTERRUPTED"
+    assert runs[0]["marked"] is True

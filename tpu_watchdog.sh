@@ -44,7 +44,8 @@ if ! JAX_PLATFORMS=cpu timeout 600 python -m dss_ml_at_scale_tpu.config.cli \
   exit 1
 fi
 # 2100s: must exceed the SUM of tier-1 per-scenario child timeouts
-# (~1680s worst case with the group_fit grid launch) so a hung
+# (~1920s worst case with the group_fit grid launch and the
+# lm_serving stream) so a hung
 # scenario dies to ITS watchdog with a
 # per-scenario finding/salvage note, not to this blanket kill.
 if ! JAX_PLATFORMS=cpu timeout 2100 python -m dss_ml_at_scale_tpu.config.cli \
@@ -71,10 +72,13 @@ if ! JAX_PLATFORMS=cpu timeout 120 python -m dss_ml_at_scale_tpu.config.cli \
 fi
 # Fleet gate: 2 stub serving replicas, propagated-trace traffic, then
 # `dsst slo check --fleet` over the merged view (scrape + sketch
-# federation + fleet judgment smoke-tested over real processes).
+# federation + fleet judgment smoke-tested over real processes); plus
+# one stub LM replica streaming a propagated-trace generation through
+# the continuous-batching engine, judged with `dsst slo check --strict`
+# on its armed TTFT/inter-token objectives.
 if ! JAX_PLATFORMS=cpu timeout 300 python scripts/check_fleet_smoke.py \
     >> tpu_watchdog.log 2>&1; then
-  echo "$(date -u +%H:%M:%S) preflight FAILED: 2-replica fleet smoke (slo check --fleet) - watchdog refusing to arm" >> tpu_watchdog.log
+  echo "$(date -u +%H:%M:%S) preflight FAILED: fleet smoke (slo check --fleet + LM stream gate) - watchdog refusing to arm" >> tpu_watchdog.log
   exit 1
 fi
 echo "$(date -u +%H:%M:%S) preflight clean: lint + audit + sanitize + bench + slo + fleet" >> tpu_watchdog.log
