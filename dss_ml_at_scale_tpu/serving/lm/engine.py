@@ -32,6 +32,7 @@ makes continuous batching win, minus the model weights.
 from __future__ import annotations
 
 import dataclasses
+import math
 import queue
 import threading
 import time
@@ -86,8 +87,14 @@ class Generation:
     ``emitted``); the HTTP thread only reads the event queue and may set
     ``cancelled`` (a latch, safe without the engine lock). Events are
     ``("token", token, index)`` then exactly one terminal
-    ``("done", reason)`` or ``("error", exc)``.
+    ``("done", reason)`` or ``("error", exc)`` — :meth:`settle_once` is
+    the latch that keeps the terminal exactly-once even when engine
+    retirement and drain's leftovers sweep race to settle the same
+    generation.
     """
+
+    _guarded_by_lock = ("_settled",)
+    _lock_name = "_lock"
 
     def __init__(self, gen_id, prompt, max_new_tokens, *, temperature,
                  top_k, eos_id, seed, trace_id, deadline):
@@ -102,6 +109,8 @@ class Generation:
         self.queue: queue.Queue = queue.Queue()
         self.cancelled = False
         self.reason: str | None = None
+        self._lock = threading.Lock()
+        self._settled = False
         self.t_admit = time.monotonic()
         self.t_first: float | None = None
         self.t_last: float | None = None
@@ -122,6 +131,21 @@ class Generation:
         p = np.exp(scaled)
         p /= p.sum()
         return int(self._rng.choice(len(p), p=p))
+
+    def settle_once(self) -> bool:
+        """Claim the right to emit THE terminal event (first caller
+        wins). Engine retirement and drain's leftovers sweep can race
+        to settle the same generation; exactly one of them may emit the
+        terminal and release the admission ticket."""
+        with self._lock:
+            if self._settled:
+                return False
+            self._settled = True
+            return True
+
+    def is_settled(self) -> bool:
+        with self._lock:
+            return self._settled
 
     def next_event(self, timeout: float | None = None):
         """Block for the next stream event (raises ``queue.Empty``)."""
@@ -273,8 +297,10 @@ class LMEngine:
         # Generations pulled off _waiting but not yet in _active (their
         # prefill is running): drain must see this in-transit window or
         # it can declare the engine empty mid-admission and truncate a
-        # stream it promised to finish.
-        self._admitting = 0
+        # stream it promised to finish — and its leftovers sweep must
+        # settle them if the engine thread wedges, so the actual
+        # Generations are tracked, not just a count.
+        self._admitting: list[Generation] = []
         self._accepting = True
         self._stopped = False
         self._gen_seq = 0
@@ -320,8 +346,11 @@ class LMEngine:
         """Admit one generation (or raise the HTTP-mapped refusal).
 
         Raises :class:`PromptTooLong` (400) when the request cannot fit
-        the preallocated capacity, ``QueueFull`` (429) at the admission
-        bound, ``NotAccepting`` (503) while draining.
+        the preallocated capacity, ``ValueError`` (400) for sampling
+        params the engine thread could not survive (non-finite
+        temperature, out-of-range top_k — json accepts NaN, so the door
+        must not), ``QueueFull`` (429) at the admission bound,
+        ``NotAccepting`` (503) while draining.
         """
         prompt = [int(t) for t in prompt]
         n_new = int(max_new_tokens)
@@ -334,6 +363,23 @@ class LMEngine:
         vocab = self.decoder.vocab_size
         if any(t < 0 or t >= vocab for t in prompt):
             raise ValueError(f"prompt tokens must lie in [0, {vocab})")
+        # Sampling-state validation: everything Generation.sample and
+        # default_rng consume is checked HERE, before the admission
+        # ticket — a bad value past this point would blow up inside the
+        # shared engine thread (or leak a ticket), not in this request.
+        temperature = float(temperature)
+        if not math.isfinite(temperature):
+            raise ValueError(f"temperature must be finite, got {temperature}")
+        if top_k is not None:
+            top_k = int(top_k)
+            if not 1 <= top_k <= vocab:
+                raise ValueError(
+                    f"top_k must lie in [1, vocab_size={vocab}], "
+                    f"got {top_k}"
+                )
+        seed = int(seed)
+        if seed < 0:
+            raise ValueError(f"seed must be >= 0, got {seed}")
         buckets = self.cfg.prefill_buckets
         if len(prompt) > buckets[-1]:
             raise PromptTooLong(
@@ -411,20 +457,57 @@ class LMEngine:
             self._stopped = True
             self._cond.notify_all()
         thread = self._thread
+        alive = False
         if thread is not None:
             thread.join(5.0)
-        # Settle anything the budget abandoned (engine thread is gone).
+            alive = thread.is_alive()
+        # Settle anything the budget abandoned — including generations
+        # caught in the in-transit admission window (neither waiting
+        # nor active while their prefill runs). The join may have timed
+        # out with the thread wedged inside a slow decoder call; the
+        # settle-once latch makes this sweep safe to race against a
+        # thread that later comes back and retires the same slots.
         with self._cond:
-            leftovers = list(self._waiting) + list(self._active.values())
+            leftovers = (
+                list(self._waiting) + list(self._active.values())
+                + list(self._admitting)
+            )
             self._waiting.clear()
             self._active.clear()
+            self._admitting.clear()
         for gen in leftovers:
             self._settle(gen, "drain")
-        return clean
+        return clean and not alive
 
     # -- engine thread ------------------------------------------------
 
     def _loop(self) -> None:
+        try:
+            self._run()
+        except Exception as exc:
+            # Nothing may escape the engine thread: an unguarded raise
+            # here used to kill the loop silently — every in-flight
+            # stream stalled and every later request hung until its
+            # event timeout. Fail CLOSED instead: refuse new work (503)
+            # and settle every owned generation with an error event.
+            self._halt(exc)
+
+    def _halt(self, exc: Exception) -> None:
+        with self._cond:
+            self._accepting = False
+            self._stopped = True
+            leftovers = (
+                list(self._waiting) + list(self._active.values())
+                + list(self._admitting)
+            )
+            self._waiting.clear()
+            self._active.clear()
+            self._admitting.clear()
+            self._cond.notify_all()
+        for gen in leftovers:
+            self._settle(gen, "error", error=exc)
+
+    def _run(self) -> None:
         while True:
             admitted, expired, cancelled = [], [], []
             with self._cond:
@@ -451,16 +534,35 @@ class LMEngine:
                     else:
                         admitted.append((gen, slot))
                 self._waiting[:] = still_waiting
-                self._admitting += len(admitted)
+                self._admitting.extend(gen for gen, _ in admitted)
             for gen in cancelled:
                 self._settle(gen, "cancelled")
             for gen in expired:
-                self._settle(gen, "deadline", error=True)
+                self._settle(
+                    gen, "deadline",
+                    error=DeadlineExceeded(
+                        "deadline passed before a slot freed"
+                    ),
+                )
             for gen, slot in admitted:
-                self._admit_into_slot(gen, slot)
+                try:
+                    self._admit_into_slot(gen, slot)
+                except Exception as exc:
+                    # A poisoned generation (sampling state the door's
+                    # validation could not foresee) retires ITSELF, not
+                    # the shared loop: free its slot, settle it with an
+                    # error event, keep serving everyone else.
+                    with self._cond:
+                        self._active.pop(slot, None)
+                        self._slots_gauge.set(len(self._active))
+                    if not gen.is_settled():
+                        self._alloc.free(slot)
+                        self._settle(gen, "error", error=exc)
             if admitted:
                 with self._cond:
-                    self._admitting -= len(admitted)
+                    for gen, _ in admitted:
+                        if gen in self._admitting:
+                            self._admitting.remove(gen)
                     self._cond.notify_all()
             self._step_once()
 
@@ -497,8 +599,11 @@ class LMEngine:
             active = dict(self._active)
         if not active:
             return
-        tokens = np.zeros(self.cfg.slots, np.int32)
-        pos = np.zeros(self.cfg.slots, np.int32)
+        # Sized to the DECODER's arena, not cfg.slots: both backends
+        # iterate/vmap over decoder.slots, and the constructor allows a
+        # decoder with more slots than the config admits.
+        tokens = np.zeros(self.decoder.slots, np.int32)
+        pos = np.zeros(self.decoder.slots, np.int32)
         for slot, gen in active.items():
             tokens[slot] = gen.last_token
             pos[slot] = gen.n_past
@@ -516,7 +621,14 @@ class LMEngine:
             if gen.deadline is not None and now > gen.deadline:
                 self._retire_slot(slot, gen, reason="deadline")
                 continue
-            token = gen.sample(logits[slot])
+            try:
+                token = gen.sample(logits[slot])
+            except Exception as exc:
+                # Per-generation blast radius: a sample() failure
+                # retires this slot with an error event; the step loop
+                # and every other stream keep running.
+                self._retire_slot(slot, gen, reason="error", error=exc)
+                continue
             gap = now - (gen.t_last if gen.t_last is not None else now)
             gen.t_last = now
             self._emit(gen, token)
@@ -527,6 +639,10 @@ class LMEngine:
 
     def _emit(self, gen: Generation, token: int) -> None:
         gen.last_token = token
+        if gen.is_settled():
+            # Drain's sweep already emitted the terminal event while
+            # this thread was wedged: no tokens after a terminal.
+            return
         gen.queue.put(("token", token, gen.emitted))
         gen.emitted += 1
         self._tokens_total.inc()
@@ -541,7 +657,8 @@ class LMEngine:
         return False
 
     def _retire_slot(self, slot: int, gen: Generation,
-                     reason: str | None = None) -> None:
+                     reason: str | None = None,
+                     error: Exception | None = None) -> None:
         with self._cond:
             self._active.pop(slot, None)
             self._slots_gauge.set(len(self._active))
@@ -551,16 +668,23 @@ class LMEngine:
         # Seconds-per-generation normalized by slot count: the cost one
         # admission adds to the shared step loop, feeding Retry-After.
         self._admission.note_service_rate(wall / max(1, self.cfg.slots))
-        self._settle(gen, reason or gen.reason or "done")
+        self._settle(gen, reason or gen.reason or "done", error=error)
 
     def _settle(self, gen: Generation, reason: str,
-                error: bool = False) -> None:
+                error: Exception | None = None) -> None:
+        """Terminal event + admission release, exactly once.
+
+        Engine retirement, the drain sweep, and the halt path can race
+        to settle the same generation; the per-generation latch makes
+        every settlement after the first a no-op, so a client sees ONE
+        terminal and the pending count can never go negative.
+        """
+        if not gen.settle_once():
+            return
         if gen.reason is None:
             gen.reason = reason
-        if error:
-            gen.queue.put(("error", DeadlineExceeded(
-                "deadline passed before a slot freed"
-            )))
+        if error is not None:
+            gen.queue.put(("error", error))
         else:
             gen.queue.put(("done", gen.reason))
         self._retired.labels(reason=gen.reason).inc()

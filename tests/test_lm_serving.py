@@ -4,9 +4,13 @@ The continuous-batching contract, layer by layer:
 
 - slot arena: alloc/free/reuse churn, double-free refusal;
 - engine semantics over the stub decoder: deterministic streams under
-  churn, capacity refusals BEFORE a slot is touched, deadline
-  retirement (both the in-slot and the never-slotted flavors), drain =
-  finish in-flight then refuse;
+  churn, capacity AND sampling-param refusals BEFORE a slot is touched
+  (a bad top_k/NaN temperature must 400 at the door, never reach the
+  shared engine thread), a poisoned generation settles with an error
+  event instead of killing the loop, settlement is exactly-once even
+  when drain races retirement, deadline retirement (both the in-slot
+  and the never-slotted flavors), drain = finish in-flight then
+  refuse;
 - numerics: a churned engine over the real TransformerDecoder streams
   bitwise the same tokens as solo decoding and as
   ``models.transformer.generate`` — continuous batching is a
@@ -20,6 +24,7 @@ The continuous-batching contract, layer by layer:
 import http.client
 import json
 import os
+import queue
 import signal
 import subprocess
 import sys
@@ -139,6 +144,103 @@ def test_capacity_refusals_before_any_slot(stub_engine):
         stub_engine.submit([97], 4)
     # Nothing was admitted by any refusal.
     assert stub_engine.pending == 0
+
+
+def test_bad_sampling_params_rejected_at_the_door(stub_engine):
+    """top_k > vocab / NaN temperature / negative seed used to reach
+    Generation.sample (or default_rng) INSIDE the engine thread and
+    kill the shared decode loop; they must 400 before admission."""
+    with pytest.raises(ValueError, match="top_k"):
+        stub_engine.submit([1], 4, top_k=999)  # vocab is 97
+    with pytest.raises(ValueError, match="top_k"):
+        stub_engine.submit([1], 4, top_k=0)
+    with pytest.raises(ValueError, match="temperature"):
+        stub_engine.submit([1], 4, temperature=float("nan"))
+    with pytest.raises(ValueError, match="temperature"):
+        stub_engine.submit([1], 4, temperature=float("inf"))
+    with pytest.raises(ValueError, match="seed"):
+        stub_engine.submit([1], 4, seed=-1)
+    # No refusal leaked an admission ticket.
+    assert stub_engine.pending == 0
+    # The decode loop never saw any of it: a valid request streams.
+    tokens, terminal = _collect(stub_engine.submit([1], 3))
+    assert terminal == ("done", "max_tokens") and len(tokens) == 3
+
+
+def test_engine_survives_poisoned_generation():
+    """Defense in depth behind the door validation: a generation whose
+    per-token work raises inside the engine thread settles with an
+    error event and frees its slot — the loop keeps serving others."""
+    cfg = LMConfig(slots=2, max_len=48, prefill_buckets=(8,))
+    engine = LMEngine(
+        StubLMDecoder(vocab_size=97, step_ms=1.0, slots=2, max_len=48,
+                      buckets=(8,)),
+        cfg,
+    )
+    bad = engine.submit([1, 2], 4)
+    good_prompt = [3, 4]
+    good = engine.submit(good_prompt, 4)
+
+    def _boom(_row):
+        raise RuntimeError("poisoned sampling state")
+
+    bad.sample = _boom  # corrupt AFTER validation, pre-start
+    engine.start()
+    try:
+        tokens, terminal = _collect(bad)
+        assert tokens == []
+        assert terminal[0] == "error"
+        assert "poisoned" in str(terminal[1])
+        gtokens, gterminal = _collect(good)
+        assert gterminal == ("done", "max_tokens")
+        assert gtokens == _stub_expected(engine.decoder, good_prompt, 4)
+        # The poisoned slot was freed and its ticket released.
+        assert engine._alloc.n_used == 0
+        assert engine.pending == 0
+    finally:
+        engine.drain(5.0)
+
+
+def test_settlement_is_idempotent():
+    """The drain-timeout race: the sweep settles a generation a wedged
+    engine thread later retires. The second settlement must be a no-op
+    — one terminal event, one admission release, pending never goes
+    negative."""
+    cfg = LMConfig(slots=1, max_len=48, prefill_buckets=(8,))
+    engine = LMEngine(
+        StubLMDecoder(slots=1, max_len=48, buckets=(8,)), cfg
+    )  # never started: both settlements are ours
+    gen = engine.submit([1], 1)
+    assert engine.pending == 1
+    engine._settle(gen, "drain")
+    engine._settle(gen, "done")  # the racing late retirement
+    assert gen.next_event(timeout=1.0) == ("done", "drain")
+    with pytest.raises(queue.Empty):
+        gen.next_event(timeout=0.1)
+    assert engine.pending == 0
+
+
+def test_decoder_with_more_slots_than_config():
+    """A decoder arena larger than cfg.slots is legal: step arrays are
+    sized to the decoder, allocation to the config — this used to
+    IndexError on the first step and kill the engine thread."""
+    cfg = LMConfig(slots=2, max_len=48, prefill_buckets=(8,))
+    engine = LMEngine(
+        StubLMDecoder(vocab_size=97, step_ms=1.0, slots=4, max_len=48,
+                      buckets=(8,)),
+        cfg,
+    ).start()
+    try:
+        prompts = [[i + 1, i + 2] for i in range(4)]
+        gens = [engine.submit(p, 5, seed=i)
+                for i, p in enumerate(prompts)]
+        for prompt, gen in zip(prompts, gens):
+            tokens, terminal = _collect(gen)
+            assert terminal == ("done", "max_tokens")
+            assert tokens == _stub_expected(engine.decoder, prompt, 5)
+        assert engine._alloc.n_used == 0
+    finally:
+        engine.drain(5.0)
 
 
 def test_deadline_retires_slot_and_frees_it():
@@ -344,6 +446,30 @@ def test_oversized_request_is_400_not_a_scatter(lm_server):
     status, _, tokens, done = _stream(
         handle.port, {"tokens": [1, 2], "max_new_tokens": 3})
     assert status == 200 and len(tokens) == 3
+
+
+def test_bad_sampling_params_400_over_http(lm_server):
+    """The reviewer repro: POST /generate with top_k > vocab (or NaN
+    temperature, which json.loads happily parses) used to crash the
+    decode thread and hang every later request. Now: 400 at the door,
+    engine stays alive."""
+    handle, _ = lm_server
+    status, _, _, body = _stream(
+        handle.port,
+        {"tokens": [1, 2], "max_new_tokens": 4, "top_k": 999})
+    assert status == 400
+    assert "top_k" in body["error"]
+    status, _, _, body = _stream(
+        handle.port,
+        {"tokens": [1, 2], "max_new_tokens": 4,
+         "temperature": float("nan")})
+    assert status == 400
+    assert "temperature" in body["error"]
+    # The decode loop survived both: a valid request still streams.
+    status, _, tokens, done = _stream(
+        handle.port, {"tokens": [1, 2], "max_new_tokens": 3})
+    assert status == 200 and len(tokens) == 3
+    assert done["done"] == "max_tokens"
 
 
 # -- chaos: SIGKILL a replica, doctor classifies it ------------------------
